@@ -1,0 +1,17 @@
+"""fastconsensus_tpu: TPU-native fast consensus clustering.
+
+A from-scratch JAX/XLA re-design of fast consensus clustering (Tandon et al.,
+Phys. Rev. E 2019, arXiv:1902.04014) with the capabilities of the reference
+implementation (ytabatabaee/fastconsensus): run a base community-detection
+algorithm n_p times, accumulate per-edge co-membership counts, threshold weak
+edges at tau*n_p, densify by triadic closure, iterate to delta-convergence.
+
+Design (SURVEY.md §7): the graph is a static-shape COO slab resident in HBM;
+the n_p ensemble runs are a vmapped batch axis (sharded over the device mesh);
+the consensus round is one jitted function built from segment reductions.
+"""
+
+from fastconsensus_tpu.graph import GraphSlab, pack_edges, host_edges
+from fastconsensus_tpu.version import __version__
+
+__all__ = ["GraphSlab", "pack_edges", "host_edges", "__version__"]

@@ -1,0 +1,166 @@
+"""Static-shape, device-resident graph substrate.
+
+The reference keeps all graph state in a mutable ``networkx.Graph``
+(dict-of-dicts; see reference ``fast_consensus.py:131-136``) and crosses into
+igraph's C structure per detection run (``fast_consensus.py:41-52``).  On TPU
+that design is untenable: XLA wants static shapes and pure functions.
+
+Here the graph is a **fixed-capacity COO edge slab**:
+
+* ``src``/``dst``     int32[capacity]  canonical endpoints (src < dst),
+* ``weight``          float32[capacity],
+* ``alive``           bool[capacity]   validity mask.
+
+"Edge deletion" (tau-thresholding, reference ``fast_consensus.py:163-168``) is
+mask-out; "edge insertion" (triadic closure, ``fast_consensus.py:175-191``)
+writes into free slots.  The edge universe grows by at most L edges per
+consensus round, so a capacity of ``E0 + slack`` keeps every round jittable
+with static shapes.  The host touches the graph exactly twice: one
+``device_put`` of the packed slab at the start, one readback of final
+memberships at the end (BASELINE.json north star).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GraphSlab:
+    """Fixed-capacity undirected weighted graph in COO form.
+
+    Edges are stored once in canonical orientation (``src < dst``).  Padding /
+    dead slots have ``alive == False``; their ``src``/``dst`` content is
+    meaningless and must never be read unmasked.
+
+    ``n_nodes`` is static metadata (part of the jit cache key), not a traced
+    array.
+    """
+
+    src: jax.Array     # int32[capacity]
+    dst: jax.Array     # int32[capacity]
+    weight: jax.Array  # float32[capacity]
+    alive: jax.Array   # bool[capacity]
+    n_nodes: int = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def capacity(self) -> int:
+        return self.src.shape[0]
+
+    def num_alive(self) -> jax.Array:
+        return jnp.sum(self.alive.astype(jnp.int32))
+
+    def with_weights(self, weight: jax.Array, alive: Optional[jax.Array] = None
+                     ) -> "GraphSlab":
+        return dataclasses.replace(
+            self, weight=weight, alive=self.alive if alive is None else alive)
+
+    def directed(self) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Both orientations of every edge: (srcd, dstd, weightd, alived).
+
+        Shape 2*capacity.  This is the view all per-node reductions consume
+        (neighbor votes, degrees, community statistics).
+        """
+        srcd = jnp.concatenate([self.src, self.dst])
+        dstd = jnp.concatenate([self.dst, self.src])
+        wd = jnp.concatenate([self.weight, self.weight])
+        ad = jnp.concatenate([self.alive, self.alive])
+        return srcd, dstd, wd, ad
+
+    def degrees(self) -> jax.Array:
+        """Alive-degree (edge count) per node, int32[n_nodes]."""
+        srcd, _, _, ad = self.directed()
+        seg = jnp.where(ad, srcd, self.n_nodes)
+        return jax.ops.segment_sum(
+            ad.astype(jnp.int32), seg, num_segments=self.n_nodes + 1)[:-1]
+
+    def strengths(self) -> jax.Array:
+        """Weighted degree per node, float32[n_nodes]."""
+        srcd, _, wd, ad = self.directed()
+        seg = jnp.where(ad, srcd, self.n_nodes)
+        return jax.ops.segment_sum(
+            jnp.where(ad, wd, 0.0), seg, num_segments=self.n_nodes + 1)[:-1]
+
+
+def pack_edges(edges: np.ndarray,
+               n_nodes: int,
+               weights: Optional[np.ndarray] = None,
+               capacity: Optional[int] = None) -> GraphSlab:
+    """Host-side: canonicalize, dedupe and pad an edge array into a GraphSlab.
+
+    ``edges`` is int[E, 2] with compact 0-based node ids.  Self-loops are
+    dropped (the reference's input graphs are simple).  Duplicate edges are
+    merged keeping the first weight.  Default capacity is ``2 * E + 16``:
+    triadic closure adds at most L = E0 edges per round net of thresholding
+    (reference ``fast_consensus.py:175``), and insertion drops overflow with a
+    reported counter rather than crashing.
+    """
+    edges = np.asarray(edges, dtype=np.int64)
+    if weights is None:
+        weights = np.ones(edges.shape[0], dtype=np.float32)
+    weights = np.asarray(weights, dtype=np.float32)
+    u = np.minimum(edges[:, 0], edges[:, 1])
+    v = np.maximum(edges[:, 0], edges[:, 1])
+    keep = u != v
+    u, v, weights = u[keep], v[keep], weights[keep]
+    key = u * np.int64(n_nodes) + v
+    _, first = np.unique(key, return_index=True)
+    first.sort()
+    u, v, weights = u[first], v[first], weights[first]
+    n_edges = u.shape[0]
+    if capacity is None:
+        capacity = 2 * n_edges + 16
+    if capacity < n_edges:
+        raise ValueError(f"capacity {capacity} < edge count {n_edges}")
+    src = np.zeros(capacity, dtype=np.int32)
+    dst = np.zeros(capacity, dtype=np.int32)
+    w = np.zeros(capacity, dtype=np.float32)
+    alive = np.zeros(capacity, dtype=bool)
+    src[:n_edges] = u
+    dst[:n_edges] = v
+    w[:n_edges] = weights
+    alive[:n_edges] = True
+    return GraphSlab(src=jnp.asarray(src), dst=jnp.asarray(dst),
+                     weight=jnp.asarray(w), alive=jnp.asarray(alive),
+                     n_nodes=int(n_nodes))
+
+
+def host_edges(slab: GraphSlab) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Readback: alive (u, v, w) triples as numpy arrays."""
+    src = np.asarray(slab.src)
+    dst = np.asarray(slab.dst)
+    w = np.asarray(slab.weight)
+    alive = np.asarray(slab.alive)
+    return src[alive], dst[alive], w[alive]
+
+
+def to_networkx(slab: GraphSlab):
+    """Debug/interop boundary: materialize a networkx.Graph on host."""
+    import networkx as nx
+
+    g = nx.Graph()
+    g.add_nodes_from(range(slab.n_nodes))
+    u, v, w = host_edges(slab)
+    g.add_weighted_edges_from(zip(u.tolist(), v.tolist(), w.tolist()))
+    return g
+
+
+def from_networkx(g, capacity: Optional[int] = None) -> GraphSlab:
+    """Interop: pack a networkx graph whose nodes are hashable ids.
+
+    Node ids are compacted to 0..N-1 by sorted order; the caller keeps the
+    mapping if it needs original ids (see utils/io.py for file-level I/O).
+    """
+    nodes = sorted(g.nodes())
+    index = {n: i for i, n in enumerate(nodes)}
+    edges = np.array([[index[a], index[b]] for a, b in g.edges()],
+                     dtype=np.int64).reshape(-1, 2)
+    wts = np.array([d.get("weight", 1.0) for _, _, d in g.edges(data=True)],
+                   dtype=np.float32)
+    return pack_edges(edges, len(nodes), weights=wts, capacity=capacity)

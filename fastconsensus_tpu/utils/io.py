@@ -1,0 +1,122 @@
+"""Host-side I/O boundary: edgelist parsing and partition writers.
+
+Mirrors the reference's file formats while fixing its ingest bugs:
+
+* the reference crashes on the 3-column weighted format its own README
+  documents (``nx.read_edgelist(..., nodetype=int)`` literal-evals column 3,
+  reference ``fast_consensus.py:434``) — here both 2- and 3-column files
+  parse; input weights are accepted but, like the reference, overwritten with
+  1.0 at the start of the consensus loop (``fast_consensus.py:135-136``);
+* the reference requires 0-indexed contiguous ids (relabeling commented out at
+  ``fast_consensus.py:435-436``) — here arbitrary integer ids are compacted
+  and original ids restored on output.
+
+Output formats (reference ``fast_consensus.py:440-466``):
+
+* ``out_partitions_t{t}_d{d}_np{np}/{i}`` — one community per line,
+  space-separated original node ids;
+* ``memberships_t{t}_d{d}_np{np}/{i}`` — ``node\tcommunity`` lines, 1-indexed
+  (the reference only writes these for louvain; we write them for every
+  algorithm, as merged_consensus.py:319-328 does, but keep fc's 1-indexing).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def read_edgelist(path: str) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray]:
+    """Parse an edgelist file.
+
+    Accepts lines ``u v`` or ``u v w``; ``#`` comments and blank lines are
+    skipped.  Node ids may be arbitrary (possibly sparse) integers.
+
+    Returns ``(edges, weights, original_ids)`` where ``edges`` is int64[E, 2]
+    in compact 0-based ids, ``weights`` is float32[E] or None if the file had
+    no weight column, and ``original_ids[i]`` is the input id of compact
+    node ``i`` (sorted ascending).
+    """
+    us: List[int] = []
+    vs: List[int] = []
+    ws: List[float] = []
+    saw_weight = False
+    with open(path) as fh:
+        for ln, line in enumerate(fh, 1):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            if len(parts) < 2:
+                raise ValueError(f"{path}:{ln}: expected 'u v [w]', got {line!r}")
+            us.append(int(parts[0]))
+            vs.append(int(parts[1]))
+            if len(parts) >= 3:
+                saw_weight = True
+                ws.append(float(parts[2]))
+            else:
+                ws.append(1.0)
+    if not us:
+        raise ValueError(f"{path}: empty edgelist")
+    u = np.asarray(us, dtype=np.int64)
+    v = np.asarray(vs, dtype=np.int64)
+    original_ids = np.unique(np.concatenate([u, v]))
+    lookup = {int(n): i for i, n in enumerate(original_ids)}
+    edges = np.stack([
+        np.asarray([lookup[int(x)] for x in u], dtype=np.int64),
+        np.asarray([lookup[int(x)] for x in v], dtype=np.int64),
+    ], axis=1)
+    weights = np.asarray(ws, dtype=np.float32) if saw_weight else None
+    return edges, weights, original_ids
+
+
+def labels_to_communities(labels: np.ndarray) -> List[List[int]]:
+    """Group a membership vector into a list of communities.
+
+    Communities are ordered by their smallest member; members ascending.
+    (Reference ``group_to_partition``, fast_consensus.py:55-71, keyed by
+    first-seen order — ordering is cosmetic, contents identical.)
+    """
+    labels = np.asarray(labels)
+    order = np.argsort(labels, kind="stable")
+    sorted_labels = labels[order]
+    boundaries = np.flatnonzero(np.diff(sorted_labels)) + 1
+    groups = np.split(order, boundaries)
+    groups.sort(key=lambda g: int(g.min()))
+    return [sorted(int(x) for x in g) for g in groups]
+
+
+def write_partition_dirs(out_dir: str,
+                         memberships_dir: str,
+                         partitions: Sequence[np.ndarray],
+                         original_ids: np.ndarray,
+                         one_indexed_memberships: bool = True) -> None:
+    """Write the reference's two output trees for a list of label vectors."""
+    os.makedirs(out_dir, exist_ok=True)
+    os.makedirs(memberships_dir, exist_ok=True)
+    original_ids = np.asarray(original_ids)
+    for i, labels in enumerate(partitions, start=1):
+        labels = np.asarray(labels)
+        with open(os.path.join(out_dir, str(i)), "w") as fh:
+            for comm in labels_to_communities(labels):
+                fh.write(" ".join(str(int(original_ids[n])) for n in comm))
+                fh.write("\n")
+        off = 1 if one_indexed_memberships else 0
+        # memberships are written in compact node order; compact community ids
+        _, compact = np.unique(labels, return_inverse=True)
+        with open(os.path.join(memberships_dir, str(i - 1)), "w") as fh:
+            for n in range(labels.shape[0]):
+                fh.write(f"{int(original_ids[n]) + off}\t{int(compact[n]) + off}\n")
+
+
+def read_partition_file(path: str) -> List[List[int]]:
+    """Read one out_partitions file back (one community per line)."""
+    comms: List[List[int]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                comms.append([int(x) for x in line.split()])
+    return comms

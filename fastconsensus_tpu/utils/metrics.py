@@ -1,0 +1,65 @@
+"""Partition-quality metrics (host-side, numpy).
+
+The reference computes no metrics at all (SURVEY.md §5); its validation
+protocol is the paper's: NMI against planted partitions on LFR graphs.  These
+are the metrics the test-suite and benchmark harness use for that protocol.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def nmi(labels_a, labels_b) -> float:
+    """Normalized mutual information (arithmetic normalization), in [0, 1].
+
+    Matches sklearn's ``normalized_mutual_info_score(average_method=
+    'arithmetic')``; implemented directly so the framework has no sklearn
+    dependency on the hot path.
+    """
+    a = np.asarray(labels_a).ravel()
+    b = np.asarray(labels_b).ravel()
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    n = a.shape[0]
+    _, ai = np.unique(a, return_inverse=True)
+    _, bi = np.unique(b, return_inverse=True)
+    ka, kb = ai.max() + 1, bi.max() + 1
+    cont = np.zeros((ka, kb), dtype=np.float64)
+    np.add.at(cont, (ai, bi), 1.0)
+    pij = cont / n
+    pa = pij.sum(axis=1)
+    pb = pij.sum(axis=0)
+    outer = pa[:, None] * pb[None, :]
+    nz = pij > 0
+    mi = float((pij[nz] * np.log(pij[nz] / outer[nz])).sum())
+    ha = float(-(pa[pa > 0] * np.log(pa[pa > 0])).sum())
+    hb = float(-(pb[pb > 0] * np.log(pb[pb > 0])).sum())
+    if ha == 0.0 and hb == 0.0:
+        return 1.0
+    denom = 0.5 * (ha + hb)
+    if denom == 0.0:
+        return 0.0
+    return max(0.0, min(1.0, mi / denom))
+
+
+def modularity(src, dst, weight, labels) -> float:
+    """Newman modularity of a partition of an undirected weighted graph.
+
+    Edges are given once (canonical orientation); self-loops count once.
+    """
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    w = np.asarray(weight, dtype=np.float64)
+    labels = np.asarray(labels)
+    m2 = 2.0 * w.sum()          # 2m
+    if m2 == 0.0:
+        return 0.0
+    n_comm = int(labels.max()) + 1
+    strength = np.zeros(labels.shape[0], dtype=np.float64)
+    np.add.at(strength, src, w)
+    np.add.at(strength, dst, w)
+    sigma_tot = np.zeros(n_comm, dtype=np.float64)
+    np.add.at(sigma_tot, labels, strength)
+    intra = w[labels[src] == labels[dst]].sum()
+    return float(2.0 * intra / m2 - np.square(sigma_tot / m2).sum())

@@ -1,0 +1,34 @@
+"""Single keyed PRNG tree.
+
+The reference mixes four uncorrelated randomness sources (stdlib ``random``,
+``np.random`` global, python-louvain's internal RNG, leiden seeds
+``range(n_p)`` — reference fast_consensus.py:125-127,148,177,181) and is
+reproducible only on the leiden path.  Here every random draw descends from
+one ``jax.random`` key via ``fold_in``, making the whole framework replayable
+from a single ``--seed``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+# Stable stream tags: fold_in(key, TAG) partitions the key tree by purpose.
+STREAM_DETECT = 0x01      # base-algorithm randomness (one sub-key per partition)
+STREAM_CLOSURE = 0x02     # triadic-closure sampling, per round
+STREAM_FINAL = 0x03       # final re-detection runs
+STREAM_DATA = 0x04        # synthetic benchmark graph generation
+
+
+def stream(key: jax.Array, tag: int, *indices: int) -> jax.Array:
+    """Derive a sub-key for a named stream and optional indices (round, p)."""
+    k = jax.random.fold_in(key, tag)
+    for ix in indices:
+        k = jax.random.fold_in(k, ix)
+    return k
+
+
+def partition_keys(key: jax.Array, n_p: int) -> jax.Array:
+    """n_p independent keys, one per ensemble partition (the vmap axis)."""
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jax.numpy.arange(n_p, dtype=jax.numpy.uint32))

@@ -1,0 +1,45 @@
+"""Test configuration: run the suite on an 8-device virtual CPU mesh.
+
+Multi-chip sharding is exercised without TPU hardware the standard JAX way
+(SURVEY.md §4): force 8 host-platform devices before jax initializes.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def karate_edges():
+    from fastconsensus_tpu.utils.io import read_edgelist
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "karate_club.txt")
+    edges, weights, ids = read_edgelist(path)
+    return edges, weights, ids
+
+
+@pytest.fixture(scope="session")
+def karate_slab(karate_edges):
+    from fastconsensus_tpu.graph import pack_edges
+
+    edges, _, ids = karate_edges
+    return pack_edges(edges, n_nodes=len(ids))
+
+
+# Zachary karate club ground truth (the two-faction split; Zachary 1977).
+KARATE_FACTIONS = np.array(
+    [0, 0, 0, 0, 0, 0, 0, 0, 1, 1, 0, 0, 0, 0, 1, 1, 0, 0, 1, 0, 1, 0,
+     1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1])
+
+
+@pytest.fixture(scope="session")
+def karate_truth():
+    return KARATE_FACTIONS

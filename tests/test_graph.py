@@ -1,0 +1,79 @@
+"""GraphSlab packing, degrees/strengths, and edgelist I/O."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from fastconsensus_tpu.graph import GraphSlab, host_edges, pack_edges
+from fastconsensus_tpu.utils.io import (labels_to_communities, read_edgelist,
+                                        read_partition_file,
+                                        write_partition_dirs)
+
+
+def test_pack_karate(karate_slab):
+    assert karate_slab.n_nodes == 34
+    assert int(karate_slab.num_alive()) == 78
+    u, v, w = host_edges(karate_slab)
+    assert np.all(u < v)
+    assert np.all(w == 1.0)
+    deg = np.asarray(karate_slab.degrees())
+    assert deg.sum() == 2 * 78
+    assert deg[0] == 16 and deg[33] == 17  # the two hubs
+
+
+def test_pack_dedup_and_selfloops():
+    edges = np.array([[0, 1], [1, 0], [1, 1], [2, 1], [0, 1]])
+    slab = pack_edges(edges, n_nodes=3)
+    u, v, w = host_edges(slab)
+    assert sorted(zip(u.tolist(), v.tolist())) == [(0, 1), (1, 2)]
+
+
+def test_strengths_weighted():
+    edges = np.array([[0, 1], [1, 2]])
+    slab = pack_edges(edges, 3, weights=np.array([2.0, 3.0]))
+    s = np.asarray(slab.strengths())
+    assert np.allclose(s, [2.0, 5.0, 3.0])
+
+
+def test_capacity_padding():
+    edges = np.array([[0, 1]])
+    slab = pack_edges(edges, 2, capacity=8)
+    assert slab.capacity == 8
+    assert int(slab.num_alive()) == 1
+
+
+def test_read_edgelist_formats(tmp_path):
+    p = tmp_path / "g.txt"
+    p.write_text("# comment\n10 20\n20 30 2.5\n\n10 30\n")
+    edges, weights, ids = read_edgelist(str(p))
+    assert ids.tolist() == [10, 20, 30]
+    assert edges.tolist() == [[0, 1], [1, 2], [0, 2]]
+    assert weights is not None and np.allclose(weights, [1.0, 2.5, 1.0])
+
+
+def test_read_edgelist_unweighted(tmp_path):
+    p = tmp_path / "g.txt"
+    p.write_text("0 1\n1 2\n")
+    edges, weights, ids = read_edgelist(str(p))
+    assert weights is None
+    assert len(ids) == 3
+
+
+def test_labels_to_communities():
+    labels = np.array([5, 5, 2, 2, 9])
+    comms = labels_to_communities(labels)
+    assert comms == [[0, 1], [2, 3], [4]]
+
+
+def test_partition_writers_roundtrip(tmp_path):
+    ids = np.array([100, 200, 300, 400])
+    labels = np.array([0, 0, 1, 1])
+    out = str(tmp_path / "parts")
+    mem = str(tmp_path / "mems")
+    write_partition_dirs(out, mem, [labels], ids)
+    comms = read_partition_file(os.path.join(out, "1"))
+    assert comms == [[100, 200], [300, 400]]
+    lines = open(os.path.join(mem, "0")).read().splitlines()
+    assert lines[0] == "101\t1" and lines[2] == "301\t2"
