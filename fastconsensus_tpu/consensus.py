@@ -1,0 +1,181 @@
+"""The consensus engine: the reference's ``while True`` loop, TPU-style.
+
+One consensus round (reference ``fast_consensus.py:138-201``) becomes a
+single jitted function over the static-shape GraphSlab:
+
+    detect (vmapped over n_p keys)          fc:148 / :211 / :268-270 / :324-335
+    -> co-membership counts per edge        fc:150-159
+    -> tau-threshold                        fc:163-168
+    -> convergence check                    fc:172 (-> fc:17-37)
+    -> triadic closure (skipped if converged)  fc:175-191
+    -> singleton repair                     fc:193-195
+    -> convergence check                    fc:201
+
+The outer loop runs on the host — a handful of rounds, one compiled step, one
+scalar readback per round (the `converged` flag + round stats).  On
+convergence the base algorithm runs n_p final times on the consensus graph
+(fc:383-411); that list of partitions is the product.
+
+Deliberate deviations from the reference, all catalogued in SURVEY.md §2.22:
+corrected co-membership accumulation (no else-misattachment), singleton
+repair to the *strongest* previous neighbor, one keyed PRNG tree, and a
+``max_rounds`` safety cap (the reference can loop forever).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fastconsensus_tpu.graph import GraphSlab, pack_edges
+from fastconsensus_tpu.models.base import Detector
+from fastconsensus_tpu.ops import consensus_ops as cops
+from fastconsensus_tpu.utils import prng
+
+
+@dataclasses.dataclass(frozen=True)
+class ConsensusConfig:
+    """Run parameters; mirrors the reference CLI surface (fc:416-428)."""
+
+    algorithm: str = "louvain"
+    n_p: int = 20
+    tau: float = 0.2          # threshold: drop edges with weight < tau * n_p
+    delta: float = 0.02       # convergence: frac of edges allowed mid-weight
+    max_rounds: int = 64      # safety cap (reference loops unboundedly)
+    seed: int = 0
+
+
+class RoundStats(NamedTuple):
+    converged: jax.Array       # bool[]
+    n_alive: jax.Array         # int32[] edges after the round
+    n_unconverged: jax.Array   # int32[] alive edges with 0 < w < n_p
+    n_closure_added: jax.Array # int32[] triadic-closure edges inserted
+    n_repaired: jax.Array      # int32[] singleton-repair edges inserted
+    n_dropped: jax.Array       # int32[] survivors dropped for capacity
+
+
+def consensus_round(slab: GraphSlab,
+                    key: jax.Array,
+                    detect: Detector,
+                    n_p: int,
+                    tau: float,
+                    delta: float,
+                    n_closure: int) -> Tuple[GraphSlab, jax.Array, RoundStats]:
+    """One full consensus round.  Jittable; all shapes static.
+
+    Returns (next_slab, labels[n_p, N], stats).  ``n_closure`` is L, the
+    original edge count (the reference re-reads it from the *input* graph
+    every round, fc:144/:175 — so it is static).
+    """
+    k_detect, k_closure = jax.random.split(key)
+    keys = prng.partition_keys(k_detect, n_p)
+    labels = detect(slab, keys)
+
+    counts = cops.comembership_counts(labels, slab.src, slab.dst)
+    prev = slab  # round-start weights; used by singleton repair (fc:194)
+    slab = cops.update_weights(slab, counts, n_p)
+    slab = cops.threshold_weights(slab, tau, n_p)
+    st_mid = cops.convergence_stats(slab, n_p, delta)
+
+    def do_closure(slab):
+        n0 = slab.num_alive()
+        csr = cops.build_csr(slab)
+        cu, cv, cvalid = cops.sample_wedges(k_closure, csr, slab.n_nodes,
+                                            n_closure)
+        cw = cops.comembership_counts(labels, cu, cv)
+        slab, dropped = cops.insert_edges(slab, cu, cv, cw, cvalid)
+        n1 = slab.num_alive()
+        su, sv, sw, svalid = cops.singleton_candidates(slab, prev)
+        slab, dropped2 = cops.insert_edges(slab, su, sv, sw, svalid)
+        return slab, n1 - n0, slab.num_alive() - n1, dropped + dropped2
+
+    def skip_closure(slab):
+        return slab, jnp.int32(0), jnp.int32(0), jnp.int32(0)
+
+    slab, n_closed, n_repaired, n_dropped = jax.lax.cond(
+        st_mid.converged, skip_closure, do_closure, slab)
+    st_end = cops.convergence_stats(slab, n_p, delta)
+    stats = RoundStats(
+        converged=st_mid.converged | st_end.converged,
+        n_alive=st_end.n_alive,
+        n_unconverged=st_end.n_unconverged,
+        n_closure_added=n_closed,
+        n_repaired=n_repaired,
+        n_dropped=n_dropped,
+    )
+    return slab, labels, stats
+
+
+class ConsensusResult(NamedTuple):
+    partitions: List[np.ndarray]   # n_p final label vectors, compact ids
+    graph: GraphSlab               # converged consensus graph
+    rounds: int
+    converged: bool
+    history: List[dict]            # per-round stats (observability, §5)
+
+
+def run_consensus(slab: GraphSlab,
+                  detect: Detector,
+                  config: ConsensusConfig,
+                  key: Optional[jax.Array] = None) -> ConsensusResult:
+    """Host-side driver: iterate jitted rounds to delta-convergence."""
+    if key is None:
+        key = jax.random.key(config.seed)
+    n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
+
+    # weights <- 1.0 at loop start (fc:135-136); input weights are ignored,
+    # matching the reference (documented in utils/io.py).
+    slab = slab.with_weights(jnp.where(slab.alive, 1.0, 0.0))
+
+    round_fn = jax.jit(functools.partial(
+        consensus_round, detect=detect, n_p=config.n_p, tau=config.tau,
+        delta=config.delta, n_closure=n_closure))
+
+    history: List[dict] = []
+    converged = False
+    rounds = 0
+    for r in range(config.max_rounds):
+        k = prng.stream(key, prng.STREAM_ROUND, r)
+        slab, _, stats = round_fn(slab, k)
+        rounds = r + 1
+        history.append({
+            "round": rounds,
+            "n_alive": int(stats.n_alive),
+            "n_unconverged": int(stats.n_unconverged),
+            "n_closure_added": int(stats.n_closure_added),
+            "n_repaired": int(stats.n_repaired),
+            "n_dropped": int(stats.n_dropped),
+        })
+        if bool(stats.converged):
+            converged = True
+            break
+
+    final_keys = prng.partition_keys(
+        prng.stream(key, prng.STREAM_FINAL), config.n_p)
+    final_labels = jax.jit(detect)(slab, final_keys)
+    partitions = [np.asarray(final_labels[i]) for i in range(config.n_p)]
+    return ConsensusResult(partitions=partitions, graph=slab, rounds=rounds,
+                           converged=converged, history=history)
+
+
+def fast_consensus(edges: np.ndarray,
+                   n_nodes: int,
+                   algorithm: str = "louvain",
+                   n_p: int = 20,
+                   tau: float = 0.2,
+                   delta: float = 0.02,
+                   seed: int = 0,
+                   max_rounds: int = 64) -> ConsensusResult:
+    """Convenience API mirroring the reference's ``fast_consensus()``
+    signature (fc:129) with edges in, partitions out."""
+    from fastconsensus_tpu.models.registry import get_detector
+
+    slab = pack_edges(edges, n_nodes)
+    cfg = ConsensusConfig(algorithm=algorithm, n_p=n_p, tau=tau, delta=delta,
+                          seed=seed, max_rounds=max_rounds)
+    return run_consensus(slab, get_detector(algorithm), cfg)

@@ -1,0 +1,35 @@
+"""Base-detection kernel interface.
+
+A detector is a pure function
+
+    detect(slab: GraphSlab, keys: uint32[n_p, ...]) -> labels int32[n_p, N]
+
+running the base community-detection algorithm once per PRNG key — the
+ensemble axis the reference executes as serial list comprehensions or a
+multiprocessing pool (fast_consensus.py:148, :210-211, :268-270, :324-335)
+and we execute as a vmapped batch axis, shardable over the device mesh.
+
+Labels need not be compact; community ids only need to be equal within a
+community (co-membership is an equality test, ops/consensus_ops.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+import jax
+
+from fastconsensus_tpu.graph import GraphSlab
+
+
+class Detector(Protocol):
+    def __call__(self, slab: GraphSlab, keys: jax.Array) -> jax.Array: ...
+
+
+def ensemble(single: Callable[[GraphSlab, jax.Array], jax.Array]) -> Detector:
+    """Lift a one-partition kernel to the n_p ensemble axis via vmap."""
+
+    def detect(slab: GraphSlab, keys: jax.Array) -> jax.Array:
+        return jax.vmap(lambda k: single(slab, k))(keys)
+
+    return detect

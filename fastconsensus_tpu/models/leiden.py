@@ -1,0 +1,70 @@
+"""Leiden-style seeded detector: local move + refinement + aggregation.
+
+Replaces ``leidenalg.find_partition(..., ModularityVertexPartition, seed=s,
+n_iterations=1)`` (reference ``fast_consensus.py:121-123``): one Leiden
+iteration — modularity local move, a *refinement* phase that re-partitions
+each community from singletons with moves constrained to stay inside the
+community (Traag et al. 2019's guard against badly-connected communities),
+aggregation over the refined partition with the aggregate initialized at the
+unrefined communities, and a final local move — returning the flat partition.
+
+Shares all machinery with models/louvain.py; the refinement constraint is an
+edge mask (intra-community edges only), so the same jitted local-move kernel
+runs all three phases.  Deviation from leidenalg (documented): refinement
+merges greedily rather than sampling merges proportional to exp(gain/theta),
+and the per-phase normalization uses the masked subgraph's weight.  Parity is
+validated at the NMI level (SURVEY.md §7 "semantics fidelity").
+
+Determinism: one partition per PRNG key — the ensemble analog of leidenalg's
+``seed=range(n_p)`` (fc:125-127), the only reproducible path in the
+reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from fastconsensus_tpu.graph import GraphSlab
+from fastconsensus_tpu.models.base import Detector, ensemble
+from fastconsensus_tpu.models.louvain import aggregate, local_move
+from fastconsensus_tpu.ops import segment as seg
+
+
+def refine(slab: GraphSlab, comm: jax.Array, key: jax.Array,
+           max_sweeps: int = 24) -> jax.Array:
+    """Constrained local move: singletons may only merge within ``comm``."""
+    n = slab.n_nodes
+    intra = slab.alive & (comm[jnp.clip(slab.src, 0, n - 1)] ==
+                          comm[jnp.clip(slab.dst, 0, n - 1)])
+    masked = dataclasses.replace(slab, alive=intra)
+    return local_move(masked, key, max_sweeps=max_sweeps)
+
+
+def leiden_single(slab: GraphSlab, key: jax.Array,
+                  max_sweeps: int = 48) -> jax.Array:
+    n = slab.n_nodes
+    k0, k1, k2 = jax.random.split(key, 3)
+
+    comm = local_move(slab, k0, max_sweeps=max_sweeps)
+    refined = seg.compact_labels(refine(slab, comm, k1), n)
+
+    # aggregate over refined groups; initialize the aggregate's partition at
+    # the unrefined communities (each refined group inherits its community)
+    agg = aggregate(slab, refined)
+    group_comm = jax.ops.segment_max(
+        comm, jnp.clip(refined, 0, n - 1), num_segments=n)
+    lvl = local_move(agg, k2, init_labels=group_comm.astype(jnp.int32),
+                     max_sweeps=max_sweeps)
+    lvl = seg.compact_labels(lvl, n)
+    return lvl[jnp.clip(refined, 0, n - 1)]
+
+
+def make_leiden(max_sweeps: int = 48) -> Detector:
+    return ensemble(functools.partial(leiden_single, max_sweeps=max_sweeps))
+
+
+leiden = make_leiden()

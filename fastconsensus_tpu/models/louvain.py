@@ -1,0 +1,175 @@
+"""Louvain as synchronous parallel modularity local-move.
+
+Replaces python-louvain's ``generate_dendrogram(randomize=True)`` +
+``partition_at_level(dend, 0)`` (reference ``fast_consensus.py:148`` — note
+the reference uses the dendrogram's *finest* level, i.e. the partition after
+the first local-move phase converges, not the top level).
+
+python-louvain moves one node at a time in random sweep order.  On TPU the
+move step is data-parallel (the GPU-Louvain formulation, arXiv:1805.10904):
+
+* every node computes, in one sorted-run segment reduction
+  (ops/segment.py), its modularity gain for joining each neighboring
+  community:  gain(i -> C) = k_i_in(C) - k_i * (Sigma_tot(C) - [C = c_i] k_i) / 2m
+* keyed jitter randomizes ties (the ``randomize=True`` analog), and a keyed
+  bernoulli *move mask* applies only a random subset of the best moves each
+  sweep — the standard cure for the swap oscillations synchronous moves
+  cause;
+* sweeps repeat until no node can improve, which is exactly python-louvain's
+  level-0 convergence criterion.
+
+``modularity_levels`` adds the aggregation phase (community graph built by
+the same run machinery) for multi-level optimization — the backend of the
+leiden detector and of final-quality-oriented uses; the louvain detector
+itself returns level-0 labels for parity with the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fastconsensus_tpu.graph import GraphSlab
+from fastconsensus_tpu.models.base import Detector, ensemble
+from fastconsensus_tpu.ops import segment as seg
+
+_JITTER = 1e-5
+
+
+def _gain_runs(slab: GraphSlab, labels: jax.Array
+               ) -> Tuple[seg.Runs, jax.Array, jax.Array]:
+    """Candidate runs (i, C, k_i_in(C)) + node strengths + community totals.
+
+    Self-loops (present in aggregated graphs) are excluded from k_i_in — a
+    node's self weight moves with it and cancels in gain comparisons — but
+    included in strengths/Sigma_tot (each self-loop contributes twice, the
+    standard convention).
+
+    A zero-weight synthetic candidate (i, c_i) per node guarantees the "stay"
+    option is always scored, even for nodes with no intra-community edge.
+    """
+    n = slab.n_nodes
+    srcd, dstd, wd, ad = slab.directed()
+    strength = slab.strengths()
+    sigma_tot = jax.ops.segment_sum(
+        strength, jnp.clip(labels, 0, n - 1), num_segments=n)
+
+    not_loop = ad & (srcd != dstd)
+    cand_node = jnp.concatenate([srcd, jnp.arange(n, dtype=jnp.int32)])
+    cand_label = jnp.concatenate([labels[dstd], labels])
+    cand_w = jnp.concatenate([wd, jnp.zeros((n,), jnp.float32)])
+    cand_valid = jnp.concatenate([not_loop, jnp.ones((n,), bool)])
+    runs = seg.node_label_runs(cand_node, cand_label, cand_w, cand_valid, n)
+    return runs, strength, sigma_tot
+
+
+def _move_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
+               m2: jax.Array, update_prob: float
+               ) -> Tuple[jax.Array, jax.Array]:
+    """One synchronous sweep.  Returns (new_labels, n_want_move)."""
+    n = slab.n_nodes
+    k_tie, k_mask = jax.random.split(key)
+    runs, strength, sigma_tot = _gain_runs(slab, labels)
+
+    k_i = strength[jnp.clip(runs.node, 0, n - 1)]
+    sig = sigma_tot[jnp.clip(runs.label, 0, n - 1)]
+    own = runs.label == labels[jnp.clip(runs.node, 0, n - 1)]
+    # gain of node i joining C (with i removed from its current community):
+    # k_i_in(C) - k_i * (Sigma_tot(C) - [i in C] k_i) / 2m
+    gain = runs.total - k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    score = gain + seg.uniform_jitter(k_tie, gain.shape, _JITTER)
+
+    best, _, has_any = seg.argmax_label_per_node(
+        runs.node, score, runs.label, runs.valid, n)
+    want = has_any & (best != labels) & (best >= 0)
+    n_want = jnp.sum(want.astype(jnp.int32))
+    mask = jax.random.bernoulli(k_mask, update_prob, (n,))
+    return jnp.where(want & mask, best, labels), n_want
+
+
+def local_move(slab: GraphSlab, key: jax.Array,
+               init_labels: jax.Array = None,
+               max_sweeps: int = 48, update_prob: float = 0.5) -> jax.Array:
+    """Run sweeps until no node can improve (or max_sweeps).  Labels are
+    community ids in [0, N); not compacted."""
+    n = slab.n_nodes
+    if init_labels is None:
+        init_labels = jnp.arange(n, dtype=jnp.int32)
+    srcd, _, wd, ad = slab.directed()
+    m2 = jnp.maximum(jnp.sum(jnp.where(ad, wd, 0.0)), 1e-9)
+
+    def cond(state):
+        _, it, n_want = state
+        return (n_want > 0) & (it < max_sweeps)
+
+    def body(state):
+        labels, it, _ = state
+        k = jax.random.fold_in(key, it)
+        new_labels, n_want = _move_step(slab, labels, k, m2, update_prob)
+        return new_labels, it + 1, n_want
+
+    labels, _, _ = jax.lax.while_loop(
+        cond, body, (init_labels, jnp.int32(0), jnp.int32(1)))
+    return labels
+
+
+def aggregate(slab: GraphSlab, labels: jax.Array) -> GraphSlab:
+    """Community graph: supernode per community, summed edge weights.
+
+    Built with the same sorted-run reduction as the vote kernels; self-loops
+    (intra-community weight) are kept — they carry Sigma_in through levels.
+    Capacity is preserved, keeping every level jittable at the same shapes.
+    """
+    n = slab.n_nodes
+    c = seg.compact_labels(labels, n)
+    cu = c[jnp.clip(slab.src, 0, n - 1)]
+    cv = c[jnp.clip(slab.dst, 0, n - 1)]
+    u = jnp.minimum(cu, cv)
+    v = jnp.maximum(cu, cv)
+    runs = seg.node_label_runs(u, v, slab.weight, slab.alive, n)
+    return GraphSlab(src=jnp.where(runs.valid, runs.node, 0),
+                     dst=jnp.where(runs.valid, runs.label, 0),
+                     weight=runs.total, alive=runs.valid, n_nodes=n)
+
+
+def modularity_levels(slab: GraphSlab, key: jax.Array, n_levels: int = 2,
+                      max_sweeps: int = 48, update_prob: float = 0.5
+                      ) -> jax.Array:
+    """Multi-level optimization; returns the *flattened* final labels.
+
+    Level 0 reproduces ``local_move``; each further level aggregates and
+    moves supernodes, then projects back — the dendrogram "top level".
+    """
+    n = slab.n_nodes
+    labels = local_move(slab, jax.random.fold_in(key, 0),
+                        max_sweeps=max_sweeps, update_prob=update_prob)
+    flat = seg.compact_labels(labels, n)       # original node -> community
+    cur = slab
+    cur_assign = flat                          # cur's nodes -> communities
+    for level in range(1, n_levels):
+        cur = aggregate(cur, cur_assign)
+        lvl = local_move(cur, jax.random.fold_in(key, level),
+                         max_sweeps=max_sweeps, update_prob=update_prob)
+        cur_assign = seg.compact_labels(lvl, n)
+        flat = cur_assign[jnp.clip(flat, 0, n - 1)]
+    return flat
+
+
+def louvain_single(slab: GraphSlab, key: jax.Array,
+                   max_sweeps: int = 48, update_prob: float = 0.5
+                   ) -> jax.Array:
+    """Level-0 partition (parity with partition_at_level(dend, 0), fc:148)."""
+    return seg.compact_labels(
+        local_move(slab, key, max_sweeps=max_sweeps,
+                   update_prob=update_prob), slab.n_nodes)
+
+
+def make_louvain(max_sweeps: int = 48, update_prob: float = 0.5) -> Detector:
+    return ensemble(functools.partial(
+        louvain_single, max_sweeps=max_sweeps, update_prob=update_prob))
+
+
+louvain = make_louvain()

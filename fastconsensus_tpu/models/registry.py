@@ -1,0 +1,72 @@
+"""Algorithm dispatch: the ``--alg`` seam.
+
+The reference dispatches on a string through inline if/elif branches
+(fast_consensus.py:141,204,260,312) or ``get_communities``
+(merged_consensus.py:131-144).  Here it is an explicit registry so new
+detectors (TPU kernels or host fallbacks) plug in without touching the
+engine — the extension point BASELINE.json's north star names.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from fastconsensus_tpu.models.base import Detector
+
+_REGISTRY: Dict[str, Callable[[], Detector]] = {}
+
+
+def register(name: str):
+    def deco(factory: Callable[[], Detector]):
+        _REGISTRY[name] = factory
+        return factory
+    return deco
+
+
+def get_detector(name: str) -> Detector:
+    try:
+        factory = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown algorithm {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+    try:
+        return factory()
+    except ImportError as e:
+        raise NotImplementedError(
+            f"algorithm {name!r} is registered but its kernel is not "
+            f"available in this build: {e}") from e
+
+
+def available() -> list:
+    return sorted(_REGISTRY)
+
+
+@register("lpm")
+def _lpm() -> Detector:
+    from fastconsensus_tpu.models.lpm import lpm
+    return lpm
+
+
+@register("louvain")
+def _louvain() -> Detector:
+    from fastconsensus_tpu.models.louvain import louvain
+    return louvain
+
+
+@register("leiden")
+def _leiden() -> Detector:
+    from fastconsensus_tpu.models.leiden import leiden
+    return leiden
+
+
+@register("cnm")
+def _cnm() -> Detector:
+    from fastconsensus_tpu.models.cnm import cnm
+    return cnm
+
+
+@register("infomap")
+def _infomap() -> Detector:
+    from fastconsensus_tpu.models.infomap import infomap
+    return infomap

@@ -1,0 +1,209 @@
+"""Jitted building blocks of the consensus round.
+
+Each op re-expresses one phase of the reference's consensus loop
+(``fast_consensus.py:129-411``) as a static-shape array program over the
+GraphSlab:
+
+* co-membership accumulation  (reference fc:150-159)  -> comembership_counts
+* tau-thresholding            (fc:163-168)            -> threshold_weights
+* delta-convergence           (fc:17-37)              -> convergence_stats
+* triadic closure             (fc:175-191)            -> sample_wedges + insert_edges
+* singleton repair            (fc:193-195)            -> singleton_candidates
+
+The consensus matrix never materializes: co-membership counts are a gather +
+compare + sum along the partition axis of a labels[n_p, N] array, restricted
+to the edge slab (the paper's sparse-consensus trick, arXiv:1902.04014).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fastconsensus_tpu.graph import GraphSlab
+
+
+def comembership_counts(labels: jax.Array, src: jax.Array, dst: jax.Array
+                        ) -> jax.Array:
+    """Per-edge count of partitions whose endpoints share a community.
+
+    ``labels`` is int32[n_p, N]; returns float32[E] in [0, n_p].  This is the
+    one-op replacement for the reference's O(E * n_p) Python dict loops
+    (fc:150-159 louvain, fc:213-221 leiden, fc:273-280 infomap/lpm).
+    """
+    agree = labels[:, src] == labels[:, dst]            # bool[n_p, E]
+    return jnp.sum(agree, axis=0, dtype=jnp.float32)
+
+
+def update_weights(slab: GraphSlab, counts: jax.Array, n_p: int) -> GraphSlab:
+    """New round weights: co-membership counts, skipping converged edges.
+
+    Edges whose weight already equals n_p (all partitions agreed last round)
+    keep it — the intended "skip already-converged edges" semantics that
+    fast_consensus.py's louvain branch garbles (else-misattachment, fc:156-159)
+    and new/merged_consensus implement (nc:157-163, mc:185-192).
+    """
+    frozen = slab.weight >= jnp.float32(n_p)
+    new_w = jnp.where(frozen, slab.weight, counts)
+    return slab.with_weights(jnp.where(slab.alive, new_w, 0.0))
+
+
+def threshold_weights(slab: GraphSlab, tau: float, n_p: int) -> GraphSlab:
+    """Kill edges with weight < tau * n_p (strict, matching fc:163-168)."""
+    keep = slab.alive & (slab.weight >= jnp.float32(tau) * jnp.float32(n_p))
+    return slab.with_weights(jnp.where(keep, slab.weight, 0.0), alive=keep)
+
+
+class ConvergenceStats(NamedTuple):
+    converged: jax.Array      # bool[]
+    n_unconverged: jax.Array  # int32[]  alive edges with 0 < w < n_p
+    n_alive: jax.Array        # int32[]
+
+
+def convergence_stats(slab: GraphSlab, n_p: int, delta: float
+                      ) -> ConvergenceStats:
+    """Converged iff #(alive edges with weight not in {0, n_p}) <= delta*|E|.
+
+    Matches check_consensus_graph (fc:17-37): weight-0 edges (closure edges no
+    partition agreed on) count in the denominator but not the numerator.
+    """
+    mid = slab.alive & (slab.weight > 0) & (slab.weight < jnp.float32(n_p))
+    n_mid = jnp.sum(mid.astype(jnp.int32))
+    n_alive = jnp.sum(slab.alive.astype(jnp.int32))
+    converged = n_mid.astype(jnp.float32) <= jnp.float32(delta) * \
+        n_alive.astype(jnp.float32)
+    return ConvergenceStats(converged, n_mid, n_alive)
+
+
+class CSR(NamedTuple):
+    """Sorted-by-source view of the alive directed edges.
+
+    ``neighbors[offsets[n]:offsets[n+1]]`` are node n's alive neighbors.
+    Static shape 2*capacity; dead entries sort to the tail.
+    """
+
+    offsets: jax.Array    # int32[n_nodes + 1]
+    neighbors: jax.Array  # int32[2 * capacity]
+
+
+def build_csr(slab: GraphSlab) -> CSR:
+    srcd, dstd, _, ad = slab.directed()
+    key = jnp.where(ad, srcd, slab.n_nodes)
+    order = jnp.argsort(key)
+    sorted_key = key[order]
+    offsets = jnp.searchsorted(
+        sorted_key, jnp.arange(slab.n_nodes + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    return CSR(offsets=offsets, neighbors=dstd[order])
+
+
+def sample_wedges(key: jax.Array, csr: CSR, n_nodes: int, n_samples: int
+                  ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Triadic-closure wedge sampling (reference fc:175-191).
+
+    L times: pick a uniform random node; if it has >= 2 alive neighbors, pick
+    two distinct ones uniformly.  Returns canonical candidate endpoints
+    (u, v) with a validity mask; candidates may duplicate existing edges —
+    insert_edges dedups (the reference's ``has_edge`` test, fc:183).
+    """
+    k_node, k_i, k_j = jax.random.split(key, 3)
+    anchors = jax.random.randint(k_node, (n_samples,), 0, n_nodes,
+                                 dtype=jnp.int32)
+    left = csr.offsets[anchors]
+    deg = csr.offsets[anchors + 1] - left
+    valid = deg >= 2
+    degf = jnp.maximum(deg, 2).astype(jnp.float32)
+    i = jnp.floor(jax.random.uniform(k_i, (n_samples,)) * degf).astype(jnp.int32)
+    j = jnp.floor(jax.random.uniform(k_j, (n_samples,)) * (degf - 1.0)
+                  ).astype(jnp.int32)
+    i = jnp.minimum(i, deg - 1)
+    j = jnp.minimum(j, deg - 2)
+    j = j + (j >= i)  # distinct pair, uniform over ordered pairs
+    a = csr.neighbors[jnp.clip(left + i, 0, csr.neighbors.shape[0] - 1)]
+    b = csr.neighbors[jnp.clip(left + j, 0, csr.neighbors.shape[0] - 1)]
+    u = jnp.minimum(a, b)
+    v = jnp.maximum(a, b)
+    valid = valid & (u != v)
+    return u, v, valid
+
+
+def insert_edges(slab: GraphSlab,
+                 cand_u: jax.Array,
+                 cand_v: jax.Array,
+                 cand_w: jax.Array,
+                 cand_valid: jax.Array) -> Tuple[GraphSlab, jax.Array]:
+    """Insert candidate edges into free slots, deduplicating.
+
+    A candidate is dropped if its (u, v) already exists alive in the slab or
+    appeared earlier in the candidate list; survivors fill dead slots in slot
+    order.  Returns the new slab and the number of survivors dropped for lack
+    of capacity (reported, never an error — the reference can grow its
+    networkx graph unboundedly; we trade that for static shapes).
+    """
+    cap = slab.capacity
+    k = cand_u.shape[0]
+    n = slab.n_nodes
+
+    all_u = jnp.concatenate([jnp.where(slab.alive, slab.src, n),
+                             jnp.where(cand_valid, cand_u, n)]).astype(jnp.int32)
+    all_v = jnp.concatenate([jnp.where(slab.alive, slab.dst, n),
+                             jnp.where(cand_valid, cand_v, n)]).astype(jnp.int32)
+    tag = jnp.concatenate([jnp.zeros((cap,), jnp.int32),
+                           1 + jnp.arange(k, dtype=jnp.int32)])
+    order = jnp.lexsort((tag, all_v, all_u))
+    su, sv, st = all_u[order], all_v[order], tag[order]
+    dup_prev = jnp.concatenate([
+        jnp.zeros((1,), dtype=bool),
+        (su[1:] == su[:-1]) & (sv[1:] == sv[:-1]),
+    ])
+    surviving_sorted = (~dup_prev) & (st > 0) & (su < n)
+    # map survival back to candidate order via the unique tags 1..k
+    surv = jnp.zeros((k + 1,), dtype=bool).at[st].set(surviving_sorted,
+                                                      mode="drop")[1:]
+
+    free_slots = jnp.argsort(slab.alive, stable=True)  # dead slots first
+    n_free = jnp.sum((~slab.alive).astype(jnp.int32))
+    rank = jnp.cumsum(surv.astype(jnp.int32)) - 1
+    ok = surv & (rank < n_free)
+    slot = jnp.where(ok, free_slots[jnp.clip(rank, 0, cap - 1)], cap)
+
+    src = slab.src.at[slot].set(cand_u.astype(jnp.int32), mode="drop")
+    dst = slab.dst.at[slot].set(cand_v.astype(jnp.int32), mode="drop")
+    weight = slab.weight.at[slot].set(cand_w.astype(jnp.float32), mode="drop")
+    alive = slab.alive.at[slot].set(True, mode="drop")
+    n_dropped = jnp.sum(surv.astype(jnp.int32)) - jnp.sum(ok.astype(jnp.int32))
+    new_slab = GraphSlab(src=src, dst=dst, weight=weight, alive=alive,
+                         n_nodes=n)
+    return new_slab, n_dropped
+
+
+def singleton_candidates(slab: GraphSlab, prev: GraphSlab
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Reconnect isolated nodes to their strongest previous-round neighbor.
+
+    The reference reattaches via the *lowest*-weight previous edge (ascending
+    sort then ``[0]``, fc:193-195) while its docstring says maximum
+    (mc:94); we implement the documented/paper intent — maximum — and note the
+    deviation (SURVEY.md §2.22.11).  Returns candidate (u, v, w, valid) arrays
+    of length n_nodes, to be passed to insert_edges.
+    """
+    n = slab.n_nodes
+    isolated = slab.degrees() == 0
+
+    psrc, pdst, pw, pad = prev.directed()
+    pseg = jnp.where(pad, psrc, n)
+    neg_inf = jnp.float32(-jnp.inf)
+    best_w = jax.ops.segment_max(jnp.where(pad, pw, neg_inf), pseg,
+                                 num_segments=n + 1)[:-1]
+    at_best = pad & (pw == best_w[jnp.clip(pseg, 0, n - 1)]) & (pseg < n)
+    partner = jax.ops.segment_max(jnp.where(at_best, pdst, -1), pseg,
+                                  num_segments=n + 1)[:-1]
+
+    nodes = jnp.arange(n, dtype=jnp.int32)
+    valid = isolated & (partner >= 0)
+    u = jnp.minimum(nodes, partner)
+    v = jnp.maximum(nodes, partner)
+    w = jnp.where(jnp.isfinite(best_w), best_w, 0.0)
+    return jnp.where(valid, u, 0), jnp.where(valid, v, 0), w, valid

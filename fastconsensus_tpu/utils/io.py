@@ -15,9 +15,12 @@ Output formats (reference ``fast_consensus.py:440-466``):
 
 * ``out_partitions_t{t}_d{d}_np{np}/{i}`` — one community per line,
   space-separated original node ids;
-* ``memberships_t{t}_d{d}_np{np}/{i}`` — ``node\tcommunity`` lines, 1-indexed
-  (the reference only writes these for louvain; we write them for every
-  algorithm, as merged_consensus.py:319-328 does, but keep fc's 1-indexing).
+* ``memberships_t{t}_d{d}_np{np}/{i}`` — ``node\tcommunity`` lines in
+  1-indexed *compact* ids (the reference requires 0-indexed input and writes
+  ``id + 1``, fc:450-455; with compact ids this reproduces it exactly on
+  every input the reference accepts, and stays well-defined for arbitrary
+  ids).  The reference only writes memberships for louvain; we write them for
+  every algorithm, as merged_consensus.py:319-328 does.
 """
 
 from __future__ import annotations
@@ -104,11 +107,11 @@ def write_partition_dirs(out_dir: str,
                 fh.write(" ".join(str(int(original_ids[n])) for n in comm))
                 fh.write("\n")
         off = 1 if one_indexed_memberships else 0
-        # memberships are written in compact node order; compact community ids
+        # memberships use compact node ids (+1) — see module docstring
         _, compact = np.unique(labels, return_inverse=True)
         with open(os.path.join(memberships_dir, str(i - 1)), "w") as fh:
             for n in range(labels.shape[0]):
-                fh.write(f"{int(original_ids[n]) + off}\t{int(compact[n]) + off}\n")
+                fh.write(f"{n + off}\t{int(compact[n]) + off}\n")
 
 
 def read_partition_file(path: str) -> List[List[int]]:

@@ -14,8 +14,8 @@ import jax
 
 
 # Stable stream tags: fold_in(key, TAG) partitions the key tree by purpose.
-STREAM_DETECT = 0x01      # base-algorithm randomness (one sub-key per partition)
-STREAM_CLOSURE = 0x02     # triadic-closure sampling, per round
+STREAM_ROUND = 0x01       # one sub-key per consensus round (detection and
+                          # closure split from it inside the round)
 STREAM_FINAL = 0x03       # final re-detection runs
 STREAM_DATA = 0x04        # synthetic benchmark graph generation
 

@@ -6,11 +6,36 @@ Multi-chip sharding is exercised without TPU hardware the standard JAX way
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the shell environment pins JAX_PLATFORMS to the
+# TPU plugin, and running the suite against one real chip (with remote
+# compiles) is both slow and a shared-resource hazard.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Persistent compile cache: the suite re-jits the same kernels every run.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/fctpu_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+# The TPU-tunnel plugin registers itself from sitecustomize at interpreter
+# start (before this file runs) and hijacks backend selection even under
+# JAX_PLATFORMS=cpu; drop its factory so the suite can never touch (or hang
+# on) the shared TPU tunnel.
+try:  # pragma: no cover - environment-specific
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    # sitecustomize imported jax before this file ran, so the env vars above
+    # were already latched into jax.config — re-point them explicitly.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,53 @@
+"""CLI surface: flags, validation, output trees."""
+
+import os
+
+import numpy as np
+import pytest
+
+from fastconsensus_tpu.cli import DEFAULT_TAU, build_parser, check_arguments, main
+from fastconsensus_tpu.utils.io import read_partition_file
+
+KARATE = os.path.join(os.path.dirname(__file__), "..", "examples",
+                      "karate_club.txt")
+
+
+def test_default_tau_table_covers_all_algorithms():
+    # leiden included explicitly (the reference omits it, fc:426-428)
+    assert set(DEFAULT_TAU) == {"louvain", "lpm", "cnm", "infomap", "leiden"}
+
+
+def test_validation_rejects_bad_ranges():
+    p = build_parser()
+    a = p.parse_args(["-f", "x", "-t", "2.0"])
+    assert check_arguments(a) is not None
+    a = p.parse_args(["-f", "x", "-t", "0.5", "-d", "-0.1"])
+    assert check_arguments(a) is not None
+    a = p.parse_args(["-f", "x", "-t", "0.5", "-np", "0"])
+    assert check_arguments(a) is not None
+
+
+def test_cli_bad_file_returns_2(tmp_path):
+    rc = main(["-f", str(tmp_path / "missing.txt"), "--alg", "lpm"])
+    assert rc == 2
+
+
+def test_cli_end_to_end_lpm(tmp_path):
+    rc = main(["-f", KARATE, "--alg", "lpm", "-np", "4", "-d", "0.1",
+               "--seed", "1", "--out-dir", str(tmp_path), "--quiet"])
+    assert rc == 0
+    out = tmp_path / "out_partitions_t0.8_d0.1_np4"
+    mem = tmp_path / "memberships_t0.8_d0.1_np4"
+    assert out.is_dir() and mem.is_dir()
+    files = sorted(os.listdir(out))
+    assert files == ["1", "2", "3", "4"]
+    # every partition covers all 34 nodes exactly once
+    for f in files:
+        comms = read_partition_file(str(out / f))
+        nodes = sorted(n for c in comms for n in c)
+        assert nodes == list(range(34))
+    # membership format: node\tcomm, 1-indexed
+    first = open(mem / "0").read().splitlines()
+    assert len(first) == 34
+    node, comm = first[0].split("\t")
+    assert int(node) == 1 and int(comm) >= 1

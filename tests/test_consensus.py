@@ -1,0 +1,84 @@
+"""Consensus engine: unit properties and karate end-to-end."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fastconsensus_tpu.consensus import (ConsensusConfig, consensus_round,
+                                         run_consensus)
+from fastconsensus_tpu.graph import pack_edges
+from fastconsensus_tpu.models.lpm import lpm
+from fastconsensus_tpu.utils.metrics import nmi
+
+
+def constant_detector(labels_row):
+    """Detector returning the same fixed partition for every key."""
+    row = jnp.asarray(labels_row, dtype=jnp.int32)
+
+    def detect(slab, keys):
+        return jnp.broadcast_to(row, (keys.shape[0], row.shape[0]))
+
+    return detect
+
+
+def test_identical_partitions_converge_one_round(karate_slab):
+    # n_p identical partitions: every intra-community edge gets weight n_p,
+    # inter-community edges get 0 -> thresholded away -> converged round 1.
+    labels = np.zeros(34, np.int32)
+    labels[16:] = 1
+    det = constant_detector(labels)
+    cfg = ConsensusConfig(n_p=10, tau=0.2, delta=0.02, max_rounds=5)
+    res = run_consensus(karate_slab, det, cfg)
+    assert res.converged and res.rounds == 1
+    # final partitions are the constant partition itself
+    assert nmi(res.partitions[0], labels) == 1.0
+
+
+def test_tau_zero_keeps_all_edges(karate_slab):
+    labels = np.arange(34, dtype=np.int32)  # all singleton communities
+    det = constant_detector(labels)
+    key = jax.random.key(0)
+    slab = karate_slab.with_weights(
+        jnp.where(karate_slab.alive, 1.0, 0.0))
+    out, _, stats = consensus_round(slab, key, det, n_p=4, tau=0.0,
+                                    delta=0.02, n_closure=78)
+    # all weights 0 (nobody co-clustered), but tau=0 deletes nothing
+    assert int(stats.n_alive) >= 78
+    # all-zero weights means zero mid-weight edges -> converged
+    assert bool(stats.converged)
+
+
+def test_delta_one_converges_immediately(karate_slab):
+    cfg = ConsensusConfig(n_p=4, tau=0.2, delta=1.0, max_rounds=5)
+    res = run_consensus(karate_slab, lpm, cfg)
+    assert res.converged and res.rounds == 1
+
+
+def test_karate_lpm_end_to_end(karate_slab, karate_truth):
+    cfg = ConsensusConfig(algorithm="lpm", n_p=20, tau=0.5, delta=0.02,
+                          max_rounds=30, seed=3)
+    res = run_consensus(karate_slab, lpm, cfg)
+    assert res.converged, f"no convergence in {res.rounds} rounds"
+    assert len(res.partitions) == 20
+    # consensus partitions should agree strongly with each other ...
+    pairwise = nmi(res.partitions[0], res.partitions[1])
+    assert pairwise > 0.8
+    # ... and match the known two-faction structure reasonably
+    quality = np.mean([nmi(p, karate_truth) for p in res.partitions])
+    assert quality > 0.25, f"mean NMI vs factions {quality}"
+    # observability: every round reported stats
+    assert len(res.history) == res.rounds
+    assert all("n_alive" in h for h in res.history)
+
+
+def test_consensus_graph_stays_within_capacity():
+    rng = np.random.default_rng(0)
+    n = 60
+    mask = np.triu(rng.random((n, n)) < 0.12, k=1)
+    u, v = np.nonzero(mask)
+    slab = pack_edges(np.stack([u, v], 1), n)
+    cfg = ConsensusConfig(n_p=8, tau=0.4, delta=0.05, max_rounds=10)
+    res = run_consensus(slab, lpm, cfg)
+    assert res.graph.capacity == slab.capacity  # static shapes end to end
+    for h in res.history:
+        assert h["n_alive"] <= slab.capacity

@@ -75,5 +75,6 @@ def test_partition_writers_roundtrip(tmp_path):
     write_partition_dirs(out, mem, [labels], ids)
     comms = read_partition_file(os.path.join(out, "1"))
     assert comms == [[100, 200], [300, 400]]
+    # memberships use 1-indexed compact ids regardless of original ids
     lines = open(os.path.join(mem, "0")).read().splitlines()
-    assert lines[0] == "101\t1" and lines[2] == "301\t2"
+    assert lines[0] == "1\t1" and lines[2] == "3\t2"

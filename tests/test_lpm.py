@@ -1,0 +1,56 @@
+"""Label-propagation kernel."""
+
+import jax
+import numpy as np
+
+from fastconsensus_tpu.graph import pack_edges
+from fastconsensus_tpu.models.lpm import lpm_single, make_lpm
+from fastconsensus_tpu.utils.metrics import nmi
+
+
+def two_cliques(k=6):
+    edges = []
+    for a in range(k):
+        for b in range(a + 1, k):
+            edges.append([a, b])
+            edges.append([k + a, k + b])
+    edges.append([0, k])  # single bridge
+    return np.array(edges), 2 * k
+
+
+def test_lpm_two_cliques_exact():
+    edges, n = two_cliques()
+    slab = pack_edges(edges, n)
+    labels = np.asarray(lpm_single(slab, jax.random.key(0)))
+    # the two cliques must each be uniform, and distinct
+    assert len(set(labels[:6])) == 1
+    assert len(set(labels[6:])) == 1
+    assert labels[0] != labels[6]
+
+
+def test_lpm_ensemble_shapes_and_validity(karate_slab):
+    det = make_lpm()
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        jax.random.key(1), jax.numpy.arange(8, dtype=jax.numpy.uint32))
+    labels = np.asarray(det(karate_slab, keys))
+    assert labels.shape == (8, 34)
+    assert labels.min() >= 0
+    # compacted: ids are 0..k-1
+    for row in labels:
+        assert set(row) == set(range(row.max() + 1))
+
+
+def test_lpm_seed_sensitivity_and_determinism(karate_slab):
+    a = np.asarray(lpm_single(karate_slab, jax.random.key(0)))
+    b = np.asarray(lpm_single(karate_slab, jax.random.key(0)))
+    assert (a == b).all()  # same key -> same partition (reproducibility)
+
+
+def test_lpm_quality_on_karate(karate_slab, karate_truth):
+    # LPA on karate is noisy; require decent agreement on the best of a few
+    # seeds, mirroring the ensemble usage (never a single run).
+    best = 0.0
+    for s in range(5):
+        labels = np.asarray(lpm_single(karate_slab, jax.random.key(s)))
+        best = max(best, nmi(labels, karate_truth))
+    assert best > 0.3
