@@ -65,16 +65,32 @@ def consensus_round(slab: GraphSlab,
                     n_p: int,
                     tau: float,
                     delta: float,
-                    n_closure: int) -> Tuple[GraphSlab, jax.Array, RoundStats]:
+                    n_closure: int,
+                    ensemble_sharding=None) -> Tuple[GraphSlab, jax.Array, RoundStats]:
     """One full consensus round.  Jittable; all shapes static.
 
     Returns (next_slab, labels[n_p, N], stats).  ``n_closure`` is L, the
     original edge count (the reference re-reads it from the *input* graph
     every round, fc:144/:175 — so it is static).
+
+    ``ensemble_sharding`` (a ``NamedSharding`` with spec ``P("p")``) pins the
+    per-partition keys and labels to the mesh's ensemble axis; XLA then runs
+    each chip's shard of the ensemble locally and contracts the n_p axis of
+    the co-membership count with one ``psum`` — the round's only collective.
     """
     k_detect, k_closure = jax.random.split(key)
     keys = prng.partition_keys(k_detect, n_p)
-    labels = detect(slab, keys)
+    if ensemble_sharding is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        keys = jax.lax.with_sharding_constraint(keys, ensemble_sharding)
+        labels_sharding = NamedSharding(
+            ensemble_sharding.mesh,
+            PartitionSpec(*ensemble_sharding.spec, None))
+        labels = jax.lax.with_sharding_constraint(
+            detect(slab, keys), labels_sharding)
+    else:
+        labels = detect(slab, keys)
 
     counts = cops.comembership_counts(labels, slab.src, slab.dst)
     prev = slab  # round-start weights; used by singleton repair (fc:194)
@@ -122,8 +138,16 @@ class ConsensusResult(NamedTuple):
 def run_consensus(slab: GraphSlab,
                   detect: Detector,
                   config: ConsensusConfig,
-                  key: Optional[jax.Array] = None) -> ConsensusResult:
-    """Host-side driver: iterate jitted rounds to delta-convergence."""
+                  key: Optional[jax.Array] = None,
+                  mesh=None) -> ConsensusResult:
+    """Host-side driver: iterate jitted rounds to delta-convergence.
+
+    With ``mesh`` (a ``jax.sharding.Mesh`` from parallel/sharding.py) the
+    ensemble axis shards over the mesh's ``"p"`` axis and the edge slab over
+    its ``"e"`` axis; XLA's SPMD partitioner inserts the collectives.  The
+    reference's scale-out story is a fork+pickle process pool on one path
+    only (fc:210-211); here every algorithm shards identically.
+    """
     if key is None:
         key = jax.random.key(config.seed)
     n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
@@ -132,9 +156,26 @@ def run_consensus(slab: GraphSlab,
     # matching the reference (documented in utils/io.py).
     slab = slab.with_weights(jnp.where(slab.alive, 1.0, 0.0))
 
+    ensemble_sharding = None
+    if mesh is not None:
+        from fastconsensus_tpu.parallel import sharding as shard
+
+        slab = shard.shard_slab(slab, mesh)
+        if config.n_p % mesh.shape[shard.ENSEMBLE_AXIS] == 0:
+            ensemble_sharding = shard.keys_sharding(mesh)
+        else:
+            import warnings
+
+            warnings.warn(
+                f"n_p={config.n_p} is not divisible by the mesh ensemble "
+                f"axis ({mesh.shape[shard.ENSEMBLE_AXIS]}); running the "
+                f"ensemble unsharded. Round n_p up with parallel.pad_n_p.",
+                stacklevel=2)
+
     round_fn = jax.jit(functools.partial(
         consensus_round, detect=detect, n_p=config.n_p, tau=config.tau,
-        delta=config.delta, n_closure=n_closure))
+        delta=config.delta, n_closure=n_closure,
+        ensemble_sharding=ensemble_sharding))
 
     history: List[dict] = []
     converged = False
@@ -157,6 +198,10 @@ def run_consensus(slab: GraphSlab,
 
     final_keys = prng.partition_keys(
         prng.stream(key, prng.STREAM_FINAL), config.n_p)
+    if mesh is not None and ensemble_sharding is not None:
+        from fastconsensus_tpu.parallel import sharding as shard
+
+        final_keys = shard.shard_keys(final_keys, mesh)
     final_labels = jax.jit(detect)(slab, final_keys)
     partitions = [np.asarray(final_labels[i]) for i in range(config.n_p)]
     return ConsensusResult(partitions=partitions, graph=slab, rounds=rounds,

@@ -1,0 +1,11 @@
+from fastconsensus_tpu.parallel.sharding import (  # noqa: F401
+    EDGE_AXIS,
+    ENSEMBLE_AXIS,
+    keys_sharding,
+    labels_sharding,
+    make_mesh,
+    pad_n_p,
+    shard_keys,
+    shard_slab,
+    slab_sharding,
+)

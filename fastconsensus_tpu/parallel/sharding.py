@@ -1,0 +1,111 @@
+"""Device-mesh scale-out: ensemble- and edge-sharding for the consensus loop.
+
+The reference's only parallelism is a ``multiprocessing.Pool`` on the leiden
+path (``fast_consensus.py:210-211``) — full-graph broadcast by fork+pickle,
+results gathered by pickle return (SURVEY.md §2.24).  The TPU-native design
+replaces that with a ``jax.sharding.Mesh`` and lets XLA's SPMD partitioner
+insert the collectives:
+
+* **ensemble axis ``"p"`` (the DP analog)** — the ``n_p`` independent
+  detection runs shard over chips: ``keys[n_p, ...]`` is split along axis 0,
+  the graph slab is replicated, and each chip runs its shard of the ensemble.
+  Co-membership counting then contracts the ``n_p`` axis, which XLA lowers to
+  one ``psum`` over ICI — the only communication in the whole round.
+* **edge axis ``"e"`` (the SP/TP analog)** — for graphs too large for one
+  chip's HBM the COO slab itself shards along capacity: per-node segment
+  reductions (degrees, neighbor votes, community statistics) become local
+  partial sums + ``psum``, again inserted by the partitioner from the
+  sharding annotations rather than hand-written collectives.
+
+No hand-rolled communication backend exists or is needed (the reference has
+none either): `jit` + `NamedSharding` over the mesh IS the distributed
+backend, and it rides ICI on a real slice and DCN across hosts unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fastconsensus_tpu.graph import GraphSlab
+
+ENSEMBLE_AXIS = "p"
+EDGE_AXIS = "e"
+
+
+def make_mesh(ensemble: Optional[int] = None,
+              edge: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (ensemble, edge) mesh over the available devices.
+
+    ``ensemble=None`` takes every device not claimed by the edge axis.  A
+    1-sized axis still exists in the mesh (specs mentioning it are no-ops),
+    so callers can always annotate with both axis names.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if ensemble is None:
+        if n % edge:
+            raise ValueError(f"{n} devices not divisible by edge={edge}")
+        ensemble = n // edge
+    if ensemble * edge > n:
+        raise ValueError(
+            f"mesh {ensemble}x{edge} needs {ensemble * edge} devices, "
+            f"have {n}")
+    grid = np.asarray(devices[: ensemble * edge]).reshape(ensemble, edge)
+    return Mesh(grid, (ENSEMBLE_AXIS, EDGE_AXIS))
+
+
+def keys_sharding(mesh: Mesh) -> NamedSharding:
+    """Ensemble keys [n_p, ...] split along the ensemble axis."""
+    return NamedSharding(mesh, P(ENSEMBLE_AXIS))
+
+
+def labels_sharding(mesh: Mesh) -> NamedSharding:
+    """Labels [n_p, N] split along the ensemble axis, nodes replicated."""
+    return NamedSharding(mesh, P(ENSEMBLE_AXIS, None))
+
+
+def slab_sharding(mesh: Mesh) -> NamedSharding:
+    """Edge slab arrays [capacity] split along the edge axis.
+
+    Used as a pytree-prefix sharding for every GraphSlab leaf (all leaves are
+    capacity-length 1-D arrays; ``n_nodes`` is static metadata, not a leaf).
+    With ``edge=1`` this replicates — the pure-ensemble configuration.
+    """
+    return NamedSharding(mesh, P(EDGE_AXIS))
+
+
+def shard_slab(slab: GraphSlab, mesh: Mesh) -> GraphSlab:
+    """Place a slab on the mesh (pads capacity to the edge-axis multiple)."""
+    e = mesh.shape[EDGE_AXIS]
+    cap = slab.capacity
+    padded = math.ceil(cap / e) * e
+    if padded != cap:
+        pad = padded - cap
+        slab = GraphSlab(
+            src=jnp.pad(slab.src, (0, pad)),
+            dst=jnp.pad(slab.dst, (0, pad)),
+            weight=jnp.pad(slab.weight, (0, pad)),
+            alive=jnp.pad(slab.alive, (0, pad)),
+            n_nodes=slab.n_nodes)
+    return jax.device_put(slab, slab_sharding(mesh))
+
+
+def pad_n_p(n_p: int, mesh: Mesh) -> int:
+    """Round n_p up to a multiple of the ensemble axis size."""
+    p = mesh.shape[ENSEMBLE_AXIS]
+    return math.ceil(n_p / p) * p
+
+
+def shard_keys(keys: jax.Array, mesh: Mesh) -> jax.Array:
+    if keys.shape[0] % mesh.shape[ENSEMBLE_AXIS]:
+        raise ValueError(
+            f"n_p={keys.shape[0]} not divisible by ensemble axis "
+            f"{mesh.shape[ENSEMBLE_AXIS]}; use pad_n_p")
+    return jax.device_put(keys, keys_sharding(mesh))
