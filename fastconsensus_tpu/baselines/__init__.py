@@ -1,0 +1,1 @@
+"""Host/CPU oracle implementations used for cross-checking and baselining."""

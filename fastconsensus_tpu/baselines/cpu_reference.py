@@ -1,0 +1,124 @@
+"""CPU reference-equivalent consensus: the measurement + correctness oracle.
+
+The reference cannot run in this environment (its pinned native deps —
+igraph, leidenalg, python-louvain — are not installed), so this module
+re-implements its louvain consensus path (reference ``fast_consensus.py:
+129-201``, with the corrected semantics catalogued in SURVEY.md §2.22) on
+plain networkx, using ``networkx.community.louvain_communities`` in place of
+python-louvain.  Both are pure-Python Louvain over dict-of-dicts graphs, which
+is where ~100% of the reference's wall time goes (SURVEY.md §3.1) — so this
+is a faithful *performance* baseline and a usable *quality* oracle:
+
+* ``bench.py`` times it to produce the measured ``vs_baseline`` ratio
+  (BASELINE.md: CPU numbers "to be measured ... as step 0");
+* tests compare the TPU engine's NMI against it (SURVEY.md §4
+  "oracle cross-check").
+
+Known deviation: ``louvain_communities`` returns the dendrogram's *top*
+level while the reference uses level 0 (fc:148); for timing this is the
+cheaper choice (we are being generous to the baseline), and for NMI oracles
+the planted partition is the ground truth anyway.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def _louvain_labels(g, seed: int) -> Dict[int, int]:
+    import networkx as nx
+
+    comms = nx.community.louvain_communities(g, weight="weight", seed=seed)
+    labels: Dict[int, int] = {}
+    for i, comm in enumerate(comms):
+        for node in comm:
+            labels[node] = i
+    return labels
+
+
+def cpu_consensus(edges: np.ndarray,
+                  n_nodes: int,
+                  n_p: int = 20,
+                  tau: float = 0.2,
+                  delta: float = 0.02,
+                  seed: int = 0,
+                  max_rounds: int = 64
+                  ) -> Tuple[List[np.ndarray], int]:
+    """Reference-equivalent louvain fast consensus on networkx.
+
+    Mirrors fast_consensus.py:129-201 (louvain path) with SURVEY.md §2.22's
+    corrected semantics: proper co-membership accumulation (no
+    else-misattachment, §2.22.1), working triadic-closure membership test
+    (§2.22.4), singleton repair to the strongest neighbor (§2.22.11).
+
+    Returns (n_p final label vectors as int64[n_nodes], rounds_used).
+    """
+    import networkx as nx
+
+    rng = random.Random(seed)
+    graph = nx.Graph()
+    graph.add_nodes_from(range(n_nodes))
+    graph.add_edges_from((int(u), int(v)) for u, v in edges)
+    L = graph.number_of_edges()
+    nx.set_edge_attributes(graph, 1.0, "weight")
+
+    rounds = 0
+    for _ in range(max_rounds):
+        rounds += 1
+        parts = [_louvain_labels(graph, rng.randrange(2**31))
+                 for _ in range(n_p)]
+        nextgraph = graph.copy()
+        # co-membership counts restricted to existing edges (fc:150-159)
+        for u, v in graph.edges():
+            w = graph[u][v]["weight"]
+            if w != n_p:  # skip already-converged edges (nc:157-163)
+                w = sum(1.0 for p in parts if p[u] == p[v])
+            nextgraph[u][v]["weight"] = w
+        # tau-threshold (fc:163-168)
+        nextgraph.remove_edges_from(
+            [(u, v) for u, v, w in nextgraph.edges(data="weight")
+             if w < tau * n_p])
+        mid = sum(1 for _, _, w in nextgraph.edges(data="weight")
+                  if 0 < w < n_p)
+        if mid <= delta * max(nextgraph.number_of_edges(), 1):
+            graph = nextgraph
+            break
+        # triadic closure: L wedge samples (fc:175-191)
+        nodes = list(nextgraph.nodes())
+        for _ in range(L):
+            anchor = rng.choice(nodes)
+            nbrs = list(nextgraph[anchor])
+            if len(nbrs) < 2:
+                continue
+            a, b = rng.sample(nbrs, 2)
+            if not nextgraph.has_edge(a, b):
+                w = sum(1.0 for p in parts if p[a] == p[b])
+                nextgraph.add_edge(a, b, weight=w)
+        # singleton repair to the strongest previous neighbor (§2.22.11)
+        for node in list(nx.isolates(nextgraph)):
+            if graph.degree(node) == 0:
+                continue
+            best = max(graph[node].items(),
+                       key=lambda kv: kv[1].get("weight", 1.0))
+            nextgraph.add_edge(node, best[0], weight=best[1].get("weight", 1.0))
+        graph = nextgraph
+
+    final = []
+    for _ in range(n_p):
+        labels = _louvain_labels(graph, rng.randrange(2**31))
+        final.append(np.array([labels.get(i, 0) for i in range(n_nodes)],
+                              dtype=np.int64))
+    return final, rounds
+
+
+def time_cpu_consensus(edges: np.ndarray, n_nodes: int, **kw
+                       ) -> Tuple[float, List[np.ndarray], int]:
+    """Wall-clock one full CPU consensus run.  Returns (seconds, partitions,
+    rounds)."""
+    t0 = time.perf_counter()
+    parts, rounds = cpu_consensus(edges, n_nodes, **kw)
+    return time.perf_counter() - t0, parts, rounds

@@ -1,0 +1,46 @@
+"""Synthetic generators + CPU reference oracle (SURVEY.md §4 protocol)."""
+
+import numpy as np
+import pytest
+
+from fastconsensus_tpu.utils.metrics import nmi
+from fastconsensus_tpu.utils.synth import planted_partition
+
+
+def test_planted_partition_shapes_and_structure():
+    edges, labels = planted_partition(400, 8, 0.25, 0.01, seed=0)
+    assert labels.shape == (400,)
+    assert len(np.unique(labels)) == 8
+    assert np.all(edges[:, 0] < edges[:, 1])
+    assert edges.max() < 400
+    # intra-community edges should dominate at these densities
+    intra = (labels[edges[:, 0]] == labels[edges[:, 1]]).mean()
+    assert intra > 0.7
+
+
+def test_planted_partition_is_seed_deterministic():
+    a = planted_partition(200, 4, 0.3, 0.02, seed=9)
+    b = planted_partition(200, 4, 0.3, 0.02, seed=9)
+    assert np.array_equal(a[0], b[0])
+
+
+@pytest.mark.slow
+def test_lfr_graph_has_planted_communities():
+    from fastconsensus_tpu.utils.synth import lfr_graph
+
+    edges, labels = lfr_graph(300, 0.2, seed=1)
+    assert labels.shape == (300,)
+    assert len(np.unique(labels)) > 2
+    intra = (labels[edges[:, 0]] == labels[edges[:, 1]]).mean()
+    assert intra > 0.6
+
+
+def test_cpu_reference_oracle_recovers_planted():
+    from fastconsensus_tpu.baselines.cpu_reference import cpu_consensus
+
+    edges, truth = planted_partition(250, 5, 0.3, 0.01, seed=4)
+    parts, rounds = cpu_consensus(edges, 250, n_p=6, tau=0.2, delta=0.02,
+                                  seed=0, max_rounds=8)
+    assert len(parts) == 6
+    assert rounds >= 1
+    assert nmi(parts[0], truth) > 0.85
