@@ -1,0 +1,57 @@
+"""CNM fast-greedy detector (native C++ host kernel).
+
+The reference runs igraph's C ``community_fastgreedy`` once per randomly
+relabeled graph copy (reference ``fast_consensus.py:319-335``; the algorithm
+is deterministic, so relabeling injects the ensemble's randomness).  Greedy
+agglomeration is inherently sequential (SURVEY.md §2.23), so the kernel is
+first-party C++ (``native/src/fastgreedy.cpp``, threaded over the ensemble)
+reached through :func:`jax.pure_callback` — which keeps the detector
+composable with the jitted consensus round: the slab stays on device, XLA
+inserts the device→host→device transfer at the callback boundary.
+
+The random relabeling lives inside the C++ kernel as a per-seed node
+permutation (same mechanism, no host-side graph copies).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fastconsensus_tpu.graph import GraphSlab
+
+
+def _seeds_from_keys(keys: jax.Array) -> jax.Array:
+    """Raw uint32 key words per ensemble member (combined to 64-bit seeds on
+    the host — jax defaults to 32-bit dtypes)."""
+    data = jax.random.key_data(keys).astype(jnp.uint32)
+    return data.reshape(data.shape[0], -1)
+
+
+def _host_call(fn_name):
+    def host(src, dst, weight, alive, seed_words):
+        from fastconsensus_tpu import native
+
+        mask = np.asarray(alive)
+        words = np.asarray(seed_words).astype(np.uint64)
+        seeds = (words[:, 0] << np.uint64(32)) | words[:, -1]
+        run = getattr(native, fn_name)
+        return run(np.asarray(src)[mask], np.asarray(dst)[mask],
+                   np.asarray(weight)[mask], host.n_nodes, seeds)
+    return host
+
+
+def _make_detector(fn_name: str):
+    def detect(slab: GraphSlab, keys: jax.Array) -> jax.Array:
+        n_p = keys.shape[0]
+        host = _host_call(fn_name)
+        host.n_nodes = slab.n_nodes
+        out_shape = jax.ShapeDtypeStruct((n_p, slab.n_nodes), jnp.int32)
+        return jax.pure_callback(
+            host, out_shape, slab.src, slab.dst, slab.weight, slab.alive,
+            _seeds_from_keys(keys), vmap_method="sequential")
+    return detect
+
+
+cnm = _make_detector("cnm_labels")

@@ -1,0 +1,17 @@
+"""Infomap detector (native C++ host kernel).
+
+The reference calls igraph's C ``community_infomap`` (reference
+``fast_consensus.py:268``, ``:390``).  The map-equation search is the
+hardest algorithm in the inventory to express data-parallel (SURVEY.md §7
+hard-part 4: "no good data-parallel formulation; ship CPU fallback"), so the
+kernel is first-party C++ — a two-level map-equation optimizer with
+Louvain-style local moves and aggregation (``native/src/infomap.cpp``),
+threaded over the n_p ensemble — reached through :func:`jax.pure_callback`
+exactly like the CNM detector (see models/cnm.py for the boundary notes).
+"""
+
+from __future__ import annotations
+
+from fastconsensus_tpu.models.cnm import _make_detector
+
+infomap = _make_detector("infomap_labels")

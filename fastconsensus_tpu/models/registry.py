@@ -62,11 +62,19 @@ def _leiden() -> Detector:
 
 @register("cnm")
 def _cnm() -> Detector:
+    from fastconsensus_tpu import native
+    if not native.available():
+        raise ImportError("native C++ toolchain unavailable for the CNM "
+                          "fast-greedy kernel")
     from fastconsensus_tpu.models.cnm import cnm
     return cnm
 
 
 @register("infomap")
 def _infomap() -> Detector:
+    from fastconsensus_tpu import native
+    if not native.available():
+        raise ImportError("native C++ toolchain unavailable for the Infomap "
+                          "kernel")
     from fastconsensus_tpu.models.infomap import infomap
     return infomap

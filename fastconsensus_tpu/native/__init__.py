@@ -1,0 +1,160 @@
+"""Native (C++) host kernels: build + ctypes bindings.
+
+The reference's sequential algorithms run in third-party C/C++ (igraph's
+``community_fastgreedy`` / ``community_infomap``, SURVEY.md §2.23); here they
+are first-party C++ in ``src/``, compiled on first use into
+``libfcnative.so`` and bound through :mod:`ctypes` (pybind11 is not available
+in this environment).  The build is cached by source hash, so the compiler
+runs once per source change.
+
+Public API:
+
+* :func:`cnm_labels`     — n_p randomized CNM fast-greedy partitions
+* :func:`infomap_labels` — n_p Infomap (map equation) partitions
+* :func:`parse_edgelist` — fast ``u v [w]`` file parser
+* :func:`available`      — True if the toolchain produced a library
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_SRC_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "src")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "build")
+_SOURCES = ("fastgreedy.cpp", "infomap.cpp", "edgelist.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _source_hash() -> str:
+    h = hashlib.sha256()
+    for name in _SOURCES + ("common.hpp",):
+        with open(os.path.join(_SRC_DIR, name), "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()[:16]
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_error
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so_path = os.path.join(_BUILD_DIR, f"libfcnative-{_source_hash()}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+               "-o", so_path + ".tmp"]
+        cmd += [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True,
+                           timeout=300)
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                FileNotFoundError) as e:
+            _build_error = getattr(e, "stderr", str(e)) or str(e)
+            return None
+        os.replace(so_path + ".tmp", so_path)
+    lib = ctypes.CDLL(so_path)
+
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    for fn in (lib.fc_cnm, lib.fc_infomap):
+        fn.argtypes = [i32p, i32p, f32p, ctypes.c_int64, ctypes.c_int32,
+                       u64p, ctypes.c_int32, i32p]
+        fn.restype = None
+    lib.fc_parse_edgelist_count.argtypes = [ctypes.c_char_p, i32p]
+    lib.fc_parse_edgelist_count.restype = ctypes.c_int64
+    lib.fc_parse_edgelist_fill.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double), ctypes.c_int64]
+    lib.fc_parse_edgelist_fill.restype = None
+    return lib
+
+
+def _get_lib() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            if _build_error is not None:
+                raise ImportError(f"native build failed: {_build_error}")
+            _lib = _build()
+            if _lib is None:
+                raise ImportError(f"native build failed: {_build_error}")
+        return _lib
+
+
+def available() -> bool:
+    try:
+        _get_lib()
+        return True
+    except ImportError:
+        return False
+
+
+def _as_c(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _run_detector(fn_name: str, src: np.ndarray, dst: np.ndarray,
+                  weight: Optional[np.ndarray], n_nodes: int,
+                  seeds: np.ndarray) -> np.ndarray:
+    lib = _get_lib()
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    if weight is None:
+        weight = np.ones(src.shape[0], dtype=np.float32)
+    weight = np.ascontiguousarray(weight, dtype=np.float32)
+    seeds = np.ascontiguousarray(seeds, dtype=np.uint64)
+    n_p = int(seeds.shape[0])
+    out = np.empty((n_p, n_nodes), dtype=np.int32)
+    getattr(lib, fn_name)(
+        _as_c(src, ctypes.c_int32), _as_c(dst, ctypes.c_int32),
+        _as_c(weight, ctypes.c_float), ctypes.c_int64(src.shape[0]),
+        ctypes.c_int32(n_nodes), _as_c(seeds, ctypes.c_uint64),
+        ctypes.c_int32(n_p), _as_c(out, ctypes.c_int32))
+    return out
+
+
+def cnm_labels(src, dst, weight, n_nodes: int, seeds) -> np.ndarray:
+    """n_p randomized CNM fast-greedy partitions; int32[n_p, n_nodes]."""
+    return _run_detector("fc_cnm", np.asarray(src), np.asarray(dst),
+                         None if weight is None else np.asarray(weight),
+                         int(n_nodes), np.asarray(seeds))
+
+
+def infomap_labels(src, dst, weight, n_nodes: int, seeds) -> np.ndarray:
+    """n_p Infomap (two-level map equation) partitions; int32[n_p, N]."""
+    return _run_detector("fc_infomap", np.asarray(src), np.asarray(dst),
+                         None if weight is None else np.asarray(weight),
+                         int(n_nodes), np.asarray(seeds))
+
+
+def parse_edgelist(path: str) -> Tuple[np.ndarray, np.ndarray,
+                                       Optional[np.ndarray]]:
+    """Fast native parse of a ``u v [w]`` edgelist.
+
+    Returns raw ``(u int64[E], v int64[E], w float64[E] | None)`` —
+    unvalidated original ids; compaction stays in utils/io.py.
+    Raises ``ValueError`` on parse failure.
+    """
+    lib = _get_lib()
+    saw = ctypes.c_int32(0)
+    with _lock:
+        n = lib.fc_parse_edgelist_count(path.encode(), ctypes.byref(saw))
+        if n < 0:
+            raise ValueError(f"native parse failed for {path}")
+        u = np.empty(n, dtype=np.int64)
+        v = np.empty(n, dtype=np.int64)
+        w = np.empty(n, dtype=np.float64)
+        lib.fc_parse_edgelist_fill(
+            u.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            w.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_int64(n))
+    return u, v, (w if saw.value else None)

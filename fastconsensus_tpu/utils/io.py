@@ -42,6 +42,20 @@ def read_edgelist(path: str) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarr
     no weight column, and ``original_ids[i]`` is the input id of compact
     node ``i`` (sorted ascending).
     """
+    try:  # native single-pass parser (the framework's data loader)
+        from fastconsensus_tpu import native
+
+        if native.available():
+            u64, v64, w64 = native.parse_edgelist(path)
+            if u64.shape[0] > 0:
+                return _compact(u64, v64,
+                                None if w64 is None
+                                else w64.astype(np.float32))
+    except (ImportError, ValueError):
+        # No toolchain, or a line the fast parser rejects: fall through to
+        # the pure-Python parse, whose errors name the offending line.
+        pass
+
     us: List[int] = []
     vs: List[int] = []
     ws: List[float] = []
@@ -63,16 +77,18 @@ def read_edgelist(path: str) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarr
                 ws.append(1.0)
     if not us:
         raise ValueError(f"{path}: empty edgelist")
-    u = np.asarray(us, dtype=np.int64)
-    v = np.asarray(vs, dtype=np.int64)
-    original_ids = np.unique(np.concatenate([u, v]))
-    lookup = {int(n): i for i, n in enumerate(original_ids)}
-    edges = np.stack([
-        np.asarray([lookup[int(x)] for x in u], dtype=np.int64),
-        np.asarray([lookup[int(x)] for x in v], dtype=np.int64),
-    ], axis=1)
-    weights = np.asarray(ws, dtype=np.float32) if saw_weight else None
-    return edges, weights, original_ids
+    return _compact(np.asarray(us, dtype=np.int64),
+                    np.asarray(vs, dtype=np.int64),
+                    np.asarray(ws, dtype=np.float32) if saw_weight else None)
+
+
+def _compact(u: np.ndarray, v: np.ndarray, weights: Optional[np.ndarray]
+             ) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray]:
+    """Compact arbitrary integer ids to 0..N-1 (sorted ascending)."""
+    original_ids, inverse = np.unique(np.concatenate([u, v]),
+                                      return_inverse=True)
+    edges = np.stack([inverse[:u.shape[0]], inverse[u.shape[0]:]], axis=1)
+    return edges.astype(np.int64), weights, original_ids
 
 
 def labels_to_communities(labels: np.ndarray) -> List[List[int]]:

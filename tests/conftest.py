@@ -41,6 +41,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: slow statistical / integration tests")
+
+
 @pytest.fixture(scope="session")
 def karate_edges():
     from fastconsensus_tpu.utils.io import read_edgelist

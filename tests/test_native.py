@@ -1,0 +1,93 @@
+"""Native C++ kernels: CNM fast-greedy, Infomap, edgelist parser.
+
+These replace the reference's third-party igraph C routines
+(fast_consensus.py:268, :270, :335); correctness is checked against known
+results (karate club max-modularity Q ~ 0.3807 for fastgreedy) and planted
+partitions (SURVEY.md §4's statistical protocol).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from fastconsensus_tpu import native
+from fastconsensus_tpu.utils.metrics import modularity, nmi
+from fastconsensus_tpu.utils.synth import planted_partition
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no native toolchain")
+
+
+def test_cnm_karate_matches_known_quality(karate_edges):
+    edges, _, ids = karate_edges
+    lab = native.cnm_labels(edges[:, 0], edges[:, 1], None, len(ids),
+                            np.arange(4, dtype=np.uint64))
+    assert lab.shape == (4, 34)
+    q = modularity(edges[:, 0], edges[:, 1],
+                   np.ones(edges.shape[0]), lab[0])
+    # igraph community_fastgreedy on karate: Q = 0.3807, 3 communities
+    assert q >= 0.375
+    assert len(np.unique(lab[0])) == 3
+
+
+def test_cnm_recovers_planted_partition():
+    edges, truth = planted_partition(500, 10, 0.3, 0.005, seed=11)
+    lab = native.cnm_labels(edges[:, 0], edges[:, 1], None, 500,
+                            np.arange(3, dtype=np.uint64))
+    for row in lab:
+        assert nmi(row, truth) > 0.9
+
+
+def test_infomap_recovers_planted_partition():
+    edges, truth = planted_partition(500, 10, 0.3, 0.005, seed=11)
+    lab = native.infomap_labels(edges[:, 0], edges[:, 1], None, 500,
+                                np.arange(3, dtype=np.uint64))
+    for row in lab:
+        assert nmi(row, truth) > 0.9
+
+
+def test_infomap_weighted_graph_respects_weights():
+    # two cliques bridged by a heavy edge: with tiny intra weights the
+    # map equation should still split on the (structural) communities
+    edges, truth = planted_partition(200, 4, 0.4, 0.01, seed=3)
+    w = np.ones(edges.shape[0], dtype=np.float32)
+    lab = native.infomap_labels(edges[:, 0], edges[:, 1], w, 200,
+                                np.arange(2, dtype=np.uint64))
+    assert nmi(lab[0], truth) > 0.9
+
+
+def test_parser_matches_python_reader(tmp_path):
+    p = tmp_path / "g.txt"
+    p.write_text("# comment\n1 2\n2 3 0.5\n\n3 9\n")
+    u, v, w = native.parse_edgelist(str(p))
+    assert u.tolist() == [1, 2, 3]
+    assert v.tolist() == [2, 3, 9]
+    assert w is not None and w.tolist() == [1.0, 0.5, 1.0]
+
+
+def test_parser_unweighted(tmp_path):
+    p = tmp_path / "g.txt"
+    p.write_text("0 1\n1 2\n")
+    u, v, w = native.parse_edgelist(str(p))
+    assert w is None
+    assert u.tolist() == [0, 1]
+
+
+def test_parser_agrees_with_io_on_karate():
+    from fastconsensus_tpu.utils.io import read_edgelist
+
+    path = os.path.join(os.path.dirname(__file__), "..", "examples",
+                        "karate_club.txt")
+    edges, weights, ids = read_edgelist(path)
+    assert edges.shape == (78, 2)
+    assert len(ids) == 34
+
+
+def test_detectors_are_seed_deterministic():
+    edges, _ = planted_partition(300, 6, 0.3, 0.01, seed=2)
+    s = np.array([42, 42], dtype=np.uint64)
+    a = native.infomap_labels(edges[:, 0], edges[:, 1], None, 300, s)
+    assert np.array_equal(a[0], a[1])
+    b = native.cnm_labels(edges[:, 0], edges[:, 1], None, 300, s)
+    assert np.array_equal(b[0], b[1])
