@@ -55,6 +55,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory to create output trees in (default: .)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress per-round progress lines")
+    p.add_argument("--checkpoint", type=str, default=None, metavar="PATH",
+                   help="persist consensus state to PATH after every round")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from --checkpoint if it exists")
+    p.add_argument("--trace-jsonl", type=str, default=None, metavar="PATH",
+                   help="append per-round stats records to a JSONL file")
+    p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
+                   help="write a jax.profiler device trace to DIR")
     return p
 
 
@@ -102,8 +110,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     cfg = ConsensusConfig(algorithm=args.alg, n_p=args.n_p, tau=args.tau,
                           delta=args.delta, max_rounds=args.max_rounds,
                           seed=args.seed)
+    from fastconsensus_tpu.utils.trace import RoundTracer, profiler_trace
+
+    tracer = RoundTracer(jsonl_path=args.trace_jsonl)
     t0 = time.perf_counter()
-    result = run_consensus(slab, detector, cfg)
+    with profiler_trace(args.profile_dir):
+        result = run_consensus(slab, detector, cfg,
+                               checkpoint_path=args.checkpoint,
+                               resume=args.resume,
+                               on_round=tracer.on_round)
     elapsed = time.perf_counter() - t0
 
     if not args.quiet:

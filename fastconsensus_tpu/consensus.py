@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Callable, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -57,6 +58,8 @@ class RoundStats(NamedTuple):
     n_closure_added: jax.Array # int32[] triadic-closure edges inserted
     n_repaired: jax.Array      # int32[] singleton-repair edges inserted
     n_dropped: jax.Array       # int32[] survivors dropped for capacity
+    n_overflow: jax.Array      # int32[] directed edges beyond d_cap, i.e.
+                               # dropped from dense move-candidate rows
 
 
 def consensus_round(slab: GraphSlab,
@@ -116,6 +119,12 @@ def consensus_round(slab: GraphSlab,
     slab, n_closed, n_repaired, n_dropped = jax.lax.cond(
         st_mid.converged, skip_closure, do_closure, slab)
     st_end = cops.convergence_stats(slab, n_p, delta)
+    if slab.d_cap > 0:
+        # candidates the dense kernels will not see next round (ops/dense_adj)
+        n_overflow = jnp.sum(
+            jnp.maximum(slab.degrees() - slab.d_cap, 0).astype(jnp.int32))
+    else:
+        n_overflow = jnp.int32(0)
     stats = RoundStats(
         converged=st_mid.converged | st_end.converged,
         n_alive=st_end.n_alive,
@@ -123,8 +132,29 @@ def consensus_round(slab: GraphSlab,
         n_closure_added=n_closed,
         n_repaired=n_repaired,
         n_dropped=n_dropped,
+        n_overflow=n_overflow,
     )
     return slab, labels, stats
+
+
+@functools.lru_cache(maxsize=128)
+def _jitted_round(detect: Detector, n_p: int, tau: float, delta: float,
+                  n_closure: int, ensemble_sharding):
+    """Cache jitted round steps across run_consensus calls.
+
+    ``jax.jit`` keys its executable cache on the *function object*; wrapping a
+    fresh ``functools.partial`` per run would recompile every round step on
+    every call (measured: ~18s/run on the TPU tunnel).  Detectors from the
+    registry are module-level singletons, so they hash stably here.
+    """
+    return jax.jit(functools.partial(
+        consensus_round, detect=detect, n_p=n_p, tau=tau, delta=delta,
+        n_closure=n_closure, ensemble_sharding=ensemble_sharding))
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_detect(detect: Detector):
+    return jax.jit(detect)
 
 
 class ConsensusResult(NamedTuple):
@@ -139,7 +169,11 @@ def run_consensus(slab: GraphSlab,
                   detect: Detector,
                   config: ConsensusConfig,
                   key: Optional[jax.Array] = None,
-                  mesh=None) -> ConsensusResult:
+                  mesh=None,
+                  checkpoint_path: Optional[str] = None,
+                  checkpoint_every: int = 1,
+                  resume: bool = False,
+                  on_round=None) -> ConsensusResult:
     """Host-side driver: iterate jitted rounds to delta-convergence.
 
     With ``mesh`` (a ``jax.sharding.Mesh`` from parallel/sharding.py) the
@@ -147,14 +181,49 @@ def run_consensus(slab: GraphSlab,
     its ``"e"`` axis; XLA's SPMD partitioner inserts the collectives.  The
     reference's scale-out story is a fork+pickle process pool on one path
     only (fc:210-211); here every algorithm shards identically.
+
+    ``checkpoint_path`` persists the consensus state every
+    ``checkpoint_every`` rounds (utils/checkpoint.py); with ``resume=True``
+    an existing checkpoint restarts the loop where it left off (the reference
+    loses everything on interruption, SURVEY.md §5).  ``on_round`` is an
+    observability hook called with each round's stats dict (utils/trace.py).
     """
     if key is None:
         key = jax.random.key(config.seed)
     n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
 
-    # weights <- 1.0 at loop start (fc:135-136); input weights are ignored,
-    # matching the reference (documented in utils/io.py).
-    slab = slab.with_weights(jnp.where(slab.alive, 1.0, 0.0))
+    start_round = 0
+    prior_history: List[dict] = []
+    resumed_converged = False
+    if resume and checkpoint_path is not None and \
+            os.path.exists(checkpoint_path):
+        from fastconsensus_tpu.utils import checkpoint as ckpt
+
+        in_nodes, in_cap = slab.n_nodes, slab.capacity
+        slab, start_round, key_data, prior_history, extra = \
+            ckpt.load_checkpoint(checkpoint_path)
+        key = jax.random.wrap_key_data(jnp.asarray(key_data))
+        # Reject checkpoints from a different run configuration: resuming a
+        # tau/n_p/algorithm/graph mismatch would silently mix semantics
+        # (weights are co-membership counts out of the *saved* n_p).
+        saved = {k: extra.get(k) for k in
+                 ("algorithm", "n_p", "tau", "delta")}
+        want = {"algorithm": config.algorithm, "n_p": config.n_p,
+                "tau": config.tau, "delta": config.delta}
+        mismatch = {k: (saved[k], want[k]) for k in want
+                    if saved[k] is not None and saved[k] != want[k]}
+        if slab.n_nodes != in_nodes or slab.capacity != in_cap:
+            mismatch["graph"] = ((slab.n_nodes, slab.capacity),
+                                 (in_nodes, in_cap))
+        if mismatch:
+            raise ValueError(
+                f"checkpoint {checkpoint_path} was written by a different "
+                f"run configuration: {mismatch} (saved, requested)")
+        resumed_converged = bool(extra.get("converged", False))
+    else:
+        # weights <- 1.0 at loop start (fc:135-136); input weights are
+        # ignored, matching the reference (documented in utils/io.py).
+        slab = slab.with_weights(jnp.where(slab.alive, 1.0, 0.0))
 
     ensemble_sharding = None
     if mesh is not None:
@@ -172,28 +241,41 @@ def run_consensus(slab: GraphSlab,
                 f"ensemble unsharded. Round n_p up with parallel.pad_n_p.",
                 stacklevel=2)
 
-    round_fn = jax.jit(functools.partial(
-        consensus_round, detect=detect, n_p=config.n_p, tau=config.tau,
-        delta=config.delta, n_closure=n_closure,
-        ensemble_sharding=ensemble_sharding))
+    round_fn = _jitted_round(detect, config.n_p, config.tau, config.delta,
+                             n_closure, ensemble_sharding)
 
-    history: List[dict] = []
-    converged = False
-    rounds = 0
-    for r in range(config.max_rounds):
+    history: List[dict] = list(prior_history)
+    converged = resumed_converged
+    rounds = start_round
+    end_round = start_round if resumed_converged else config.max_rounds
+    for r in range(start_round, end_round):
         k = prng.stream(key, prng.STREAM_ROUND, r)
         slab, _, stats = round_fn(slab, k)
         rounds = r + 1
-        history.append({
+        entry = {
             "round": rounds,
             "n_alive": int(stats.n_alive),
             "n_unconverged": int(stats.n_unconverged),
             "n_closure_added": int(stats.n_closure_added),
             "n_repaired": int(stats.n_repaired),
             "n_dropped": int(stats.n_dropped),
-        })
-        if bool(stats.converged):
-            converged = True
+            "n_overflow": int(stats.n_overflow),
+        }
+        history.append(entry)
+        if on_round is not None:
+            on_round(entry)
+        converged = bool(stats.converged)
+        if checkpoint_path is not None and \
+                (rounds % checkpoint_every == 0 or converged):
+            from fastconsensus_tpu.utils import checkpoint as ckpt
+
+            ckpt.save_checkpoint(
+                checkpoint_path, slab, rounds,
+                np.asarray(jax.random.key_data(key)), history,
+                extra={"algorithm": config.algorithm, "n_p": config.n_p,
+                       "tau": config.tau, "delta": config.delta,
+                       "converged": converged})
+        if converged:
             break
 
     final_keys = prng.partition_keys(
@@ -202,7 +284,7 @@ def run_consensus(slab: GraphSlab,
         from fastconsensus_tpu.parallel import sharding as shard
 
         final_keys = shard.shard_keys(final_keys, mesh)
-    final_labels = jax.jit(detect)(slab, final_keys)
+    final_labels = _jitted_detect(detect)(slab, final_keys)
     partitions = [np.asarray(final_labels[i]) for i in range(config.n_p)]
     return ConsensusResult(partitions=partitions, graph=slab, rounds=rounds,
                            converged=converged, history=history)

@@ -48,6 +48,11 @@ class GraphSlab:
     weight: jax.Array  # float32[capacity]
     alive: jax.Array   # bool[capacity]
     n_nodes: int = dataclasses.field(metadata=dict(static=True))
+    # Static per-node neighbor capacity for the dense (padded-row) kernels in
+    # ops/dense_adj.py; 0 = "not computed" (kernels fall back to the
+    # sorted-run path).  pack_edges sets it from the input degree histogram
+    # with slack for triadic-closure growth.
+    d_cap: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     @property
     def capacity(self) -> int:
@@ -126,9 +131,25 @@ def pack_edges(edges: np.ndarray,
     dst[:n_edges] = v
     w[:n_edges] = weights
     alive[:n_edges] = True
+    # Neighbor-row capacity for the dense kernels: 2x the input max degree
+    # (+ slack), but bounded by a degree-percentile term so one hub cannot
+    # force an O(N * max_deg) adjacency (a star graph would otherwise OOM).
+    # Rounded to a lane-friendly multiple of 8.  Nodes whose degree exceeds
+    # d_cap — hubs above the cap, or nodes triadic closure grew past it —
+    # keep all edges in the slab (counts/convergence exact) and only lose
+    # the overflow from *move candidate* rows; consensus_round reports the
+    # overflow count per round (RoundStats.n_overflow).
+    degree = np.zeros(max(n_nodes, 1) + 1, dtype=np.int64)
+    np.add.at(degree, u, 1)
+    np.add.at(degree, v, 1)
+    max_deg = int(degree[:n_nodes].max(initial=0))
+    p99 = int(np.percentile(degree[:n_nodes], 99)) if n_nodes else 0
+    bound = max(64, 4 * p99 + 8)
+    d_cap = min(2 * max_deg + 8, bound, 2048, max(n_nodes - 1, 1))
+    d_cap = int(((d_cap + 7) // 8) * 8)
     return GraphSlab(src=jnp.asarray(src), dst=jnp.asarray(dst),
                      weight=jnp.asarray(w), alive=jnp.asarray(alive),
-                     n_nodes=int(n_nodes))
+                     n_nodes=int(n_nodes), d_cap=d_cap)
 
 
 def host_edges(slab: GraphSlab) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

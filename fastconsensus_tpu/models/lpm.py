@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from fastconsensus_tpu.graph import GraphSlab
 from fastconsensus_tpu.models.base import Detector, ensemble
+from fastconsensus_tpu.ops import dense_adj as da
 from fastconsensus_tpu.ops import segment as seg
 
 
@@ -48,11 +49,34 @@ def _vote_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
     return new_labels, n_want
 
 
+def _vote_step_dense(adj: da.DenseAdj, labels: jax.Array, key: jax.Array,
+                     update_prob: float) -> Tuple[jax.Array, jax.Array]:
+    """Dense-row vote (see ops/dense_adj.py).  A node's own zero-weight
+    candidate never outscores a real neighbor vote (weights >= 1 vs jitter
+    < 0.5), so the weighted-mode semantics match _vote_step."""
+    n = adj.nbr.shape[0]
+    k_tie, k_mask = jax.random.split(key)
+    tot = da.row_label_totals(adj, labels)
+    jitter = seg.uniform_jitter(k_tie, tot.total.shape, 0.5)
+    # exclude the synthetic zero-weight own candidate unless it has real
+    # neighbor weight — isolated-in-row nodes then keep their label
+    score = jnp.where(tot.is_head & (tot.total > 0), tot.total + jitter,
+                      -jnp.inf)
+    best, want = da.best_candidate(tot, score, labels)
+    n_want = jnp.sum(want.astype(jnp.int32))
+    mask = jax.random.bernoulli(k_mask, update_prob, (n,))
+    return jnp.where(want & mask, best, labels), n_want
+
+
 def lpm_single(slab: GraphSlab, key: jax.Array,
                max_iters: int = 64, update_prob: float = 0.7) -> jax.Array:
     """One label-propagation partition; labels int32[N] (not compacted)."""
     n = slab.n_nodes
     init_labels = jnp.arange(n, dtype=jnp.int32)
+
+    dense = slab.d_cap > 0
+    if dense:
+        adj = da.build_dense_adjacency(slab)
 
     def cond(state):
         labels, it, n_want = state
@@ -61,7 +85,10 @@ def lpm_single(slab: GraphSlab, key: jax.Array,
     def body(state):
         labels, it, _ = state
         k = jax.random.fold_in(key, it)
-        new_labels, n_want = _vote_step(slab, labels, k, update_prob)
+        if dense:
+            new_labels, n_want = _vote_step_dense(adj, labels, k, update_prob)
+        else:
+            new_labels, n_want = _vote_step(slab, labels, k, update_prob)
         return new_labels, it + 1, n_want
 
     labels, _, _ = jax.lax.while_loop(

@@ -175,7 +175,7 @@ def insert_edges(slab: GraphSlab,
     alive = slab.alive.at[slot].set(True, mode="drop")
     n_dropped = jnp.sum(surv.astype(jnp.int32)) - jnp.sum(ok.astype(jnp.int32))
     new_slab = GraphSlab(src=src, dst=dst, weight=weight, alive=alive,
-                         n_nodes=n)
+                         n_nodes=n, d_cap=slab.d_cap)
     return new_slab, n_dropped
 
 

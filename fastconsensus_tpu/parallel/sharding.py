@@ -93,7 +93,7 @@ def shard_slab(slab: GraphSlab, mesh: Mesh) -> GraphSlab:
             dst=jnp.pad(slab.dst, (0, pad)),
             weight=jnp.pad(slab.weight, (0, pad)),
             alive=jnp.pad(slab.alive, (0, pad)),
-            n_nodes=slab.n_nodes)
+            n_nodes=slab.n_nodes, d_cap=slab.d_cap)
     return jax.device_put(slab, slab_sharding(mesh))
 
 

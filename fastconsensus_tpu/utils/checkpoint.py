@@ -1,0 +1,82 @@
+"""Checkpoint / resume for the consensus loop.
+
+The reference has no persistence: results are written once at the very end
+(reference ``fast_consensus.py:440-466``) and an interrupted run loses
+everything (SURVEY.md §5).  Here each consensus round is a natural
+checkpoint: the entire mutable state is one GraphSlab (four arrays), the
+round counter, and the root PRNG key — a few hundred KB even at the
+100k-node stress config.
+
+Format: a single ``.npz`` with the slab arrays + a JSON metadata blob,
+written atomically (tmp + rename) so a crash mid-write never corrupts the
+latest checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fastconsensus_tpu.graph import GraphSlab
+
+_FORMAT_VERSION = 1
+
+
+def save_checkpoint(path: str,
+                    slab: GraphSlab,
+                    rounds_done: int,
+                    key_data: np.ndarray,
+                    history: List[dict],
+                    extra: Optional[Dict[str, Any]] = None) -> None:
+    """Atomically persist the consensus state after a round."""
+    meta = {
+        "version": _FORMAT_VERSION,
+        "n_nodes": int(slab.n_nodes),
+        "d_cap": int(slab.d_cap),
+        "rounds_done": int(rounds_done),
+        "history": history,
+        "extra": extra or {},
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)),
+                               suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            np.savez(fh,
+                     src=np.asarray(slab.src),
+                     dst=np.asarray(slab.dst),
+                     weight=np.asarray(slab.weight),
+                     alive=np.asarray(slab.alive),
+                     key_data=np.asarray(key_data),
+                     meta=np.frombuffer(
+                         json.dumps(meta).encode(), dtype=np.uint8))
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_checkpoint(path: str
+                    ) -> Tuple[GraphSlab, int, np.ndarray, List[dict],
+                               Dict[str, Any]]:
+    """Load ``(slab, rounds_done, key_data, history, extra)``."""
+    import jax.numpy as jnp
+
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        if meta.get("version") != _FORMAT_VERSION:
+            raise ValueError(
+                f"{path}: unsupported checkpoint version {meta.get('version')}")
+        slab = GraphSlab(src=jnp.asarray(z["src"]),
+                         dst=jnp.asarray(z["dst"]),
+                         weight=jnp.asarray(z["weight"]),
+                         alive=jnp.asarray(z["alive"]),
+                         n_nodes=int(meta["n_nodes"]),
+                         d_cap=int(meta.get("d_cap", 0)))
+        return (slab, int(meta["rounds_done"]), z["key_data"].copy(),
+                list(meta["history"]), dict(meta["extra"]))

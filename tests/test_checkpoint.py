@@ -1,0 +1,115 @@
+"""Checkpoint / resume + tracing (SURVEY.md §5 rebuild subsystems)."""
+
+import logging
+import os
+
+import jax
+import numpy as np
+
+from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
+from fastconsensus_tpu.graph import pack_edges
+from fastconsensus_tpu.models.registry import get_detector
+from fastconsensus_tpu.utils.checkpoint import (load_checkpoint,
+                                                save_checkpoint)
+from fastconsensus_tpu.utils.synth import planted_partition
+from fastconsensus_tpu.utils.trace import RoundTracer, phase_timer
+
+
+def _slab():
+    edges, _ = planted_partition(120, 4, 0.35, 0.02, seed=8)
+    return pack_edges(edges, 120)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    slab = _slab()
+    path = str(tmp_path / "state.npz")
+    key_data = np.asarray(jax.random.key_data(jax.random.key(7)))
+    history = [{"round": 1, "n_alive": 3}]
+    save_checkpoint(path, slab, 1, key_data, history, extra={"alg": "lpm"})
+    slab2, rounds, kd, hist, extra = load_checkpoint(path)
+    assert rounds == 1
+    assert hist == history
+    assert extra == {"alg": "lpm"}
+    assert np.array_equal(kd, key_data)
+    assert np.array_equal(np.asarray(slab2.src), np.asarray(slab.src))
+    assert np.array_equal(np.asarray(slab2.alive), np.asarray(slab.alive))
+    assert slab2.n_nodes == slab.n_nodes
+
+
+def test_resume_matches_uninterrupted_run(tmp_path):
+    """A run checkpointed every round and resumed after round 1 must land on
+    the same final graph as the same run left alone (same PRNG stream)."""
+    slab = _slab()
+    detect = get_detector("lpm")
+    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.0,
+                          max_rounds=3, seed=3)
+
+    full = run_consensus(slab, detect, cfg)
+
+    path = str(tmp_path / "ck.npz")
+    cfg1 = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.0,
+                          max_rounds=1, seed=3)
+    run_consensus(slab, detect, cfg1, checkpoint_path=path)
+    assert os.path.exists(path)
+    resumed = run_consensus(slab, detect, cfg, checkpoint_path=path,
+                            resume=True)
+
+    assert resumed.rounds == full.rounds
+    assert np.array_equal(np.asarray(resumed.graph.alive),
+                          np.asarray(full.graph.alive))
+    assert np.allclose(np.asarray(resumed.graph.weight),
+                       np.asarray(full.graph.weight))
+    for a, b in zip(resumed.partitions, full.partitions):
+        assert np.array_equal(a, b)
+
+
+def test_resume_rejects_mismatched_config(tmp_path):
+    import pytest
+
+    slab = _slab()
+    detect = get_detector("lpm")
+    path = str(tmp_path / "ck.npz")
+    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.0,
+                          max_rounds=1, seed=3)
+    run_consensus(slab, detect, cfg, checkpoint_path=path)
+    bad = ConsensusConfig(algorithm="lpm", n_p=4, tau=0.5, delta=0.0,
+                          max_rounds=2, seed=3)
+    with pytest.raises(ValueError, match="different run configuration"):
+        run_consensus(slab, detect, bad, checkpoint_path=path, resume=True)
+
+
+def test_resume_after_convergence_is_a_noop(tmp_path):
+    slab = _slab()
+    detect = get_detector("lpm")
+    path = str(tmp_path / "ck.npz")
+    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=1.0,
+                          max_rounds=4, seed=3)  # delta=1: converges round 1
+    first = run_consensus(slab, detect, cfg, checkpoint_path=path)
+    assert first.converged and first.rounds == 1
+    again = run_consensus(slab, detect, cfg, checkpoint_path=path,
+                          resume=True)
+    assert again.converged and again.rounds == first.rounds
+    assert np.array_equal(np.asarray(again.graph.weight),
+                          np.asarray(first.graph.weight))
+
+
+def test_round_tracer_records_and_logs(tmp_path, caplog):
+    slab = _slab()
+    tracer = RoundTracer(jsonl_path=str(tmp_path / "trace.jsonl"))
+    cfg = ConsensusConfig(algorithm="lpm", n_p=4, tau=0.5, delta=0.02,
+                          max_rounds=2, seed=0)
+    with caplog.at_level(logging.INFO, logger="fastconsensus_tpu"):
+        result = run_consensus(slab, get_detector("lpm"), cfg,
+                               on_round=tracer.on_round)
+    assert len(tracer.records) == result.rounds
+    assert all("round_seconds" in r for r in tracer.records)
+    assert any("edges alive" in m for m in caplog.messages)
+    with open(tmp_path / "trace.jsonl") as fh:
+        assert len(fh.readlines()) == result.rounds
+
+
+def test_phase_timer_sink():
+    sink = {}
+    with phase_timer("pack", sink):
+        pass
+    assert "pack" in sink and sink["pack"] >= 0.0
