@@ -29,6 +29,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Widest per-node dense candidate row pack_edges will configure; beyond this
+# the sorted-run kernels are the better (and exact) choice.
+DENSE_D_MAX = 1024
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -132,21 +136,22 @@ def pack_edges(edges: np.ndarray,
     w[:n_edges] = weights
     alive[:n_edges] = True
     # Neighbor-row capacity for the dense kernels: 2x the input max degree
-    # (+ slack), but bounded by a degree-percentile term so one hub cannot
-    # force an O(N * max_deg) adjacency (a star graph would otherwise OOM).
-    # Rounded to a lane-friendly multiple of 8.  Nodes whose degree exceeds
-    # d_cap — hubs above the cap, or nodes triadic closure grew past it —
-    # keep all edges in the slab (counts/convergence exact) and only lose
-    # the overflow from *move candidate* rows; consensus_round reports the
-    # overflow count per round (RoundStats.n_overflow).
+    # (+ slack), rounded to a lane-friendly multiple of 8.  When even that
+    # exceeds DENSE_D_MAX (hub/star-like degree distributions, where a dense
+    # [N, max_deg] adjacency would waste or exhaust memory), d_cap is 0 and
+    # the detection kernels take the exact sorted-run path instead — the cap
+    # never silently truncates *input* neighborhoods.  Nodes that triadic
+    # closure later grows past d_cap keep all edges in the slab
+    # (counts/convergence exact) and only lose the overflow from *move
+    # candidate* rows; consensus_round reports that count per round
+    # (RoundStats.n_overflow).
     degree = np.zeros(max(n_nodes, 1) + 1, dtype=np.int64)
     np.add.at(degree, u, 1)
     np.add.at(degree, v, 1)
     max_deg = int(degree[:n_nodes].max(initial=0))
-    p99 = int(np.percentile(degree[:n_nodes], 99)) if n_nodes else 0
-    bound = max(64, 4 * p99 + 8)
-    d_cap = min(2 * max_deg + 8, bound, 2048, max(n_nodes - 1, 1))
-    d_cap = int(((d_cap + 7) // 8) * 8)
+    want = min(2 * max_deg + 8, max(n_nodes - 1, 1))
+    want = int(((want + 7) // 8) * 8)
+    d_cap = want if want <= DENSE_D_MAX else 0
     return GraphSlab(src=jnp.asarray(src), dst=jnp.asarray(dst),
                      weight=jnp.asarray(w), alive=jnp.asarray(alive),
                      n_nodes=int(n_nodes), d_cap=d_cap)

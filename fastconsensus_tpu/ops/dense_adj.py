@@ -85,9 +85,17 @@ class RowTotals(NamedTuple):
     is_head: jax.Array  # bool[N, D+1]
 
 
-def row_label_totals(adj: DenseAdj, labels: jax.Array) -> RowTotals:
+def row_label_totals(adj: DenseAdj, labels: jax.Array,
+                     use_pallas: bool = None) -> RowTotals:
     """Aggregate neighbor weight per (row, neighbor-label): the dense analog
-    of ops/segment.py:node_label_runs, one minor-axis sort per call."""
+    of ops/segment.py:node_label_runs.
+
+    Two equivalent lowerings: a Pallas O(D^2) in-VMEM broadcast-compare
+    (ops/pallas_kernels.py — default on TPU) and a minor-axis sort +
+    segmented scans (default elsewhere).  Candidate slot *order* differs
+    between the two; consumers must treat RowTotals as an unordered
+    candidate set (best_candidate does).
+    """
     n, d = adj.nbr.shape
     sentinel = jnp.int32(2**31 - 1)
 
@@ -97,6 +105,23 @@ def row_label_totals(adj: DenseAdj, labels: jax.Array) -> RowTotals:
     # append the own-label candidate with zero weight
     lab_ext = jnp.concatenate([lab_n, labels[:, None]], axis=1)
     w_ext = jnp.concatenate([w, jnp.zeros((n, 1), jnp.float32)], axis=1)
+
+    if use_pallas is None:
+        import os
+
+        env = os.environ.get("FCTPU_PALLAS", "")
+        if env in ("0", "1"):
+            use_pallas = env == "1"
+        else:
+            use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        from fastconsensus_tpu.ops import pallas_kernels as pk
+
+        total, head = pk.row_totals(lab_ext, w_ext)
+        real = lab_ext != sentinel
+        return RowTotals(label=jnp.where(real, lab_ext, 0),
+                         total=jnp.where(real, total, 0.0),
+                         is_head=head)
 
     slab_sorted, w_sorted = jax.lax.sort((lab_ext, w_ext), dimension=1,
                                          num_keys=1)

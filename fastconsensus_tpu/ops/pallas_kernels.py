@@ -1,0 +1,91 @@
+"""Pallas TPU kernels for the hot per-sweep aggregation.
+
+The detection sweeps' inner op is: per padded neighbor row, the weighted
+total of each row slot's label over the whole row, plus a first-occurrence
+mask (ops/dense_adj.py:row_label_totals — there expressed as a minor-axis
+sort + segmented scans).  Row widths are small (``d_cap`` <= 2048, typically
+~100-200), so the whole aggregation fits VMEM as an O(D^2) broadcast-compare:
+
+    total[i]   = sum_j w[j] * (lab[j] == lab[i])
+    is_head[i] = no j < i with lab[j] == lab[i]
+
+One VMEM-resident [BN, D, D] compare per node block replaces the sort's
+log^2 passes; the weighted reduction over j vectorizes on the VPU.  No
+inter-block communication, no HBM intermediates — a pure map over node
+blocks, which is exactly the shape Pallas is for.
+
+The public entry :func:`row_totals` handles padding to lane/TPU-friendly
+shapes and falls back to interpret mode off-TPU (used by the CPU test suite
+for bit-equivalence against the sort-based path).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Sentinel marking invalid row slots; must sort above any real label and
+# equal the one used by ops/dense_adj.py.  A Python int, not a jnp constant:
+# the kernel body must not close over traced arrays.
+SENTINEL = 2**31 - 1
+
+
+def _row_totals_kernel(lab_ref, w_ref, total_ref, head_ref):
+    lab = lab_ref[...]                       # int32[BN, D]
+    w = w_ref[...]                           # float32[BN, D]
+    eq = lab[:, :, None] == lab[:, None, :]  # bool[BN, D, D]; [b, i, j]
+    total_ref[...] = jnp.sum(
+        jnp.where(eq, w[:, None, :], 0.0), axis=2)
+    i_idx = jax.lax.broadcasted_iota(jnp.int32, eq.shape, 1)
+    j_idx = jax.lax.broadcasted_iota(jnp.int32, eq.shape, 2)
+    dup_earlier = jnp.any(eq & (j_idx < i_idx), axis=2)
+    real = lab != SENTINEL
+    head_ref[...] = (~dup_earlier) & real
+
+
+@functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
+def row_totals(lab: jax.Array, w: jax.Array,
+               block_n: int = None, interpret: bool = None
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Per-slot label totals + first-occurrence mask for padded rows.
+
+    ``lab`` int32[N, D] (SENTINEL = invalid slot, weight must be 0 there),
+    ``w`` float32[N, D].  Returns ``(total float32[N, D], head bool[N, D])``
+    with the same slot order as the input (no sorting).
+
+    ``block_n`` defaults to a VMEM-budgeted size: the kernel's [BN, D, D]
+    intermediates cost ~6 bytes/element, so BN shrinks as D grows (a fixed
+    BN would blow the ~16MB VMEM budget past D ~ 350).  ``interpret``
+    defaults to True off-TPU, where pallas has no native lowering.
+    """
+    n, d = lab.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_n is None:
+        dp_est = d + (-d) % 128
+        budget = 4 * 1024 * 1024  # target VMEM for the O(BN*D^2) temps
+        block_n = max(1, min(32, budget // (6 * dp_est * dp_est)))
+    n_pad = (-n) % block_n
+    d_pad = (-d) % 128
+    if n_pad or d_pad:
+        lab = jnp.pad(lab, ((0, n_pad), (0, d_pad)),
+                      constant_values=SENTINEL)
+        w = jnp.pad(w, ((0, n_pad), (0, d_pad)))
+    np_, dp = lab.shape
+
+    grid = (np_ // block_n,)
+    spec = pl.BlockSpec((block_n, dp), lambda i: (i, 0))
+    total, head = pl.pallas_call(
+        _row_totals_kernel,
+        grid=grid,
+        in_specs=[spec, spec],
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((np_, dp), jnp.float32),
+                   jax.ShapeDtypeStruct((np_, dp), jnp.bool_)],
+        interpret=interpret,
+    )(lab, w)
+    return total[:n, :d], head[:n, :d]
