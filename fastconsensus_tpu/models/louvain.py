@@ -68,7 +68,7 @@ def _gain_runs(slab: GraphSlab, labels: jax.Array
 
 
 def _move_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
-               m2: jax.Array, update_prob: float
+               m2: jax.Array, update_prob: float, gamma: float = 1.0
                ) -> Tuple[jax.Array, jax.Array]:
     """One synchronous sweep.  Returns (new_labels, n_want_move)."""
     n = slab.n_nodes
@@ -80,7 +80,7 @@ def _move_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
     own = runs.label == labels[jnp.clip(runs.node, 0, n - 1)]
     # gain of node i joining C (with i removed from its current community):
     # k_i_in(C) - k_i * (Sigma_tot(C) - [i in C] k_i) / 2m
-    gain = runs.total - k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    gain = runs.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
     score = gain + seg.uniform_jitter(k_tie, gain.shape, _JITTER)
 
     best, _, has_any = seg.argmax_label_per_node(
@@ -93,7 +93,8 @@ def _move_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
 
 def _move_step_dense(adj: da.DenseAdj, slab: GraphSlab, labels: jax.Array,
                      key: jax.Array, m2: jax.Array, strength: jax.Array,
-                     update_prob: float) -> Tuple[jax.Array, jax.Array]:
+                     update_prob: float, gamma: float = 1.0
+                     ) -> Tuple[jax.Array, jax.Array]:
     """One synchronous sweep on the padded dense adjacency.
 
     Same gain formula and semantics as _move_step, but the per-(node, label)
@@ -110,7 +111,7 @@ def _move_step_dense(adj: da.DenseAdj, slab: GraphSlab, labels: jax.Array,
     k_i = strength[:, None]
     sig = sigma_tot[jnp.clip(tot.label, 0, n - 1)]
     own = tot.label == labels[:, None]
-    gain = tot.total - k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    gain = tot.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
     jitter = seg.uniform_jitter(k_tie, gain.shape, _JITTER)
     score = jnp.where(tot.is_head, gain + jitter, -jnp.inf)
 
@@ -122,7 +123,8 @@ def _move_step_dense(adj: da.DenseAdj, slab: GraphSlab, labels: jax.Array,
 
 def local_move(slab: GraphSlab, key: jax.Array,
                init_labels: jax.Array = None,
-               max_sweeps: int = 48, update_prob: float = 0.5) -> jax.Array:
+               max_sweeps: int = 48, update_prob: float = 0.5,
+               gamma: float = 1.0) -> jax.Array:
     """Run sweeps until no node can improve (or max_sweeps).  Labels are
     community ids in [0, N); not compacted.
 
@@ -150,9 +152,10 @@ def local_move(slab: GraphSlab, key: jax.Array,
         k = jax.random.fold_in(key, it)
         if dense:
             new_labels, n_want = _move_step_dense(
-                adj, slab, labels, k, m2, strength, update_prob)
+                adj, slab, labels, k, m2, strength, update_prob, gamma)
         else:
-            new_labels, n_want = _move_step(slab, labels, k, m2, update_prob)
+            new_labels, n_want = _move_step(slab, labels, k, m2, update_prob,
+                                            gamma)
         return new_labels, it + 1, n_want
 
     labels, _, _ = jax.lax.while_loop(
@@ -205,17 +208,23 @@ def modularity_levels(slab: GraphSlab, key: jax.Array, n_levels: int = 2,
 
 
 def louvain_single(slab: GraphSlab, key: jax.Array,
-                   max_sweeps: int = 48, update_prob: float = 0.5
-                   ) -> jax.Array:
-    """Level-0 partition (parity with partition_at_level(dend, 0), fc:148)."""
+                   max_sweeps: int = 48, update_prob: float = 0.5,
+                   gamma: float = 1.0) -> jax.Array:
+    """Level-0 partition (parity with partition_at_level(dend, 0), fc:148).
+
+    ``gamma`` is the resolution parameter (gain = k_i_in - gamma k_i
+    Sigma_tot / 2m): the reference parses ``-g`` but never uses it
+    (merged_consensus.py:284-285, SURVEY.md 2.22.10); here it works."""
     return seg.compact_labels(
         local_move(slab, key, max_sweeps=max_sweeps,
-                   update_prob=update_prob), slab.n_nodes)
+                   update_prob=update_prob, gamma=gamma), slab.n_nodes)
 
 
-def make_louvain(max_sweeps: int = 48, update_prob: float = 0.5) -> Detector:
+def make_louvain(max_sweeps: int = 48, update_prob: float = 0.5,
+                 gamma: float = 1.0) -> Detector:
     return ensemble(functools.partial(
-        louvain_single, max_sweeps=max_sweeps, update_prob=update_prob))
+        louvain_single, max_sweeps=max_sweeps, update_prob=update_prob,
+        gamma=gamma))
 
 
 louvain = make_louvain()
