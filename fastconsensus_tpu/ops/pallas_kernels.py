@@ -69,6 +69,10 @@ def row_totals(lab: jax.Array, w: jax.Array,
         dp_est = d + (-d) % 128
         budget = 4 * 1024 * 1024  # target VMEM for the O(BN*D^2) temps
         block_n = max(1, min(32, budget // (6 * dp_est * dp_est)))
+        if not interpret:
+            # Mosaic requires the second-to-last block dim to be a multiple
+            # of 8 (jax pallas TPU lowering constraint).
+            block_n = max(8, block_n - block_n % 8)
     n_pad = (-n) % block_n
     d_pad = (-d) % 128
     if n_pad or d_pad:
