@@ -252,6 +252,10 @@ def run_consensus(slab: GraphSlab,
         k = prng.stream(key, prng.STREAM_ROUND, r)
         slab, _, stats = round_fn(slab, k)
         rounds = r + 1
+        # One bulk device->host transfer for the whole stats tuple: per-field
+        # scalar readbacks each pay the full device round-trip latency, which
+        # through the TPU tunnel dwarfs the round's compute (measured).
+        stats = jax.device_get(stats)
         entry = {
             "round": rounds,
             "n_alive": int(stats.n_alive),
@@ -285,7 +289,10 @@ def run_consensus(slab: GraphSlab,
 
         final_keys = shard.shard_keys(final_keys, mesh)
     final_labels = _jitted_detect(detect)(slab, final_keys)
-    partitions = [np.asarray(final_labels[i]) for i in range(config.n_p)]
+    # Single bulk readback of the [n_p, N] label matrix (per-row transfers
+    # each pay the device round-trip; see the stats readback note above).
+    all_labels = jax.device_get(final_labels)
+    partitions = [all_labels[i] for i in range(config.n_p)]
     return ConsensusResult(partitions=partitions, graph=slab, rounds=rounds,
                            converged=converged, history=history)
 
