@@ -110,10 +110,16 @@ def row_label_totals(adj: DenseAdj, labels: jax.Array,
         import os
 
         env = os.environ.get("FCTPU_PALLAS", "")
+        from fastconsensus_tpu.ops import pallas_kernels as pk
+
         if env in ("0", "1"):
             use_pallas = env == "1"
         else:
-            use_pallas = jax.default_backend() == "tpu"
+            # Wide rows blow the kernel's VMEM budget (the [8, D, D] compare
+            # temps fault the TPU worker past ~D=500); the sort path also
+            # scales better than O(D^2) there.
+            use_pallas = (jax.default_backend() == "tpu"
+                          and pk.fits_vmem(d + 1))
     if use_pallas:
         from fastconsensus_tpu.ops import pallas_kernels as pk
 

@@ -34,6 +34,18 @@ from jax.experimental import pallas as pl
 SENTINEL = 2**31 - 1
 
 
+def fits_vmem(d: int, budget: int = 12 * 1024 * 1024) -> bool:
+    """Whether the O(D^2) kernel can run at width ``d`` without faulting.
+
+    Mosaic forces the node-block to >= 8 rows, so the [8, D', D'] compare
+    temps (~6 bytes/element at padded D') are the floor cost; past the VMEM
+    budget the kernel faults the TPU worker.  Callers (dense_adj auto-select)
+    should use the sort-based path instead for wide rows.
+    """
+    dp = d + (-d) % 128
+    return 8 * 6 * dp * dp <= budget
+
+
 def _row_totals_kernel(lab_ref, w_ref, total_ref, head_ref):
     lab = lab_ref[...]                       # int32[BN, D]
     w = w_ref[...]                           # float32[BN, D]
