@@ -62,39 +62,16 @@ class RoundStats(NamedTuple):
                                # dropped from dense move-candidate rows
 
 
-def consensus_round(slab: GraphSlab,
-                    key: jax.Array,
-                    detect: Detector,
-                    n_p: int,
-                    tau: float,
-                    delta: float,
-                    n_closure: int,
-                    ensemble_sharding=None) -> Tuple[GraphSlab, jax.Array, RoundStats]:
-    """One full consensus round.  Jittable; all shapes static.
-
-    Returns (next_slab, labels[n_p, N], stats).  ``n_closure`` is L, the
-    original edge count (the reference re-reads it from the *input* graph
-    every round, fc:144/:175 — so it is static).
-
-    ``ensemble_sharding`` (a ``NamedSharding`` with spec ``P("p")``) pins the
-    per-partition keys and labels to the mesh's ensemble axis; XLA then runs
-    each chip's shard of the ensemble locally and contracts the n_p axis of
-    the co-membership count with one ``psum`` — the round's only collective.
-    """
-    k_detect, k_closure = jax.random.split(key)
-    keys = prng.partition_keys(k_detect, n_p)
-    if ensemble_sharding is not None:
-        from jax.sharding import NamedSharding, PartitionSpec
-
-        keys = jax.lax.with_sharding_constraint(keys, ensemble_sharding)
-        labels_sharding = NamedSharding(
-            ensemble_sharding.mesh,
-            PartitionSpec(*ensemble_sharding.spec, None))
-        labels = jax.lax.with_sharding_constraint(
-            detect(slab, keys), labels_sharding)
-    else:
-        labels = detect(slab, keys)
-
+def consensus_tail(slab: GraphSlab,
+                   labels: jax.Array,
+                   k_closure: jax.Array,
+                   n_p: int,
+                   tau: float,
+                   delta: float,
+                   n_closure: int) -> Tuple[GraphSlab, RoundStats]:
+    """Everything after detection: co-membership -> threshold -> convergence
+    -> closure -> repair.  Jittable; shared by the one-call
+    :func:`consensus_round` and the split-phase driver loop."""
     counts = cops.comembership_counts(labels, slab.src, slab.dst)
     prev = slab  # round-start weights; used by singleton repair (fc:194)
     slab = cops.update_weights(slab, counts, n_p)
@@ -134,6 +111,43 @@ def consensus_round(slab: GraphSlab,
         n_dropped=n_dropped,
         n_overflow=n_overflow,
     )
+    return slab, stats
+
+
+def consensus_round(slab: GraphSlab,
+                    key: jax.Array,
+                    detect: Detector,
+                    n_p: int,
+                    tau: float,
+                    delta: float,
+                    n_closure: int,
+                    ensemble_sharding=None) -> Tuple[GraphSlab, jax.Array, RoundStats]:
+    """One full consensus round.  Jittable; all shapes static.
+
+    Returns (next_slab, labels[n_p, N], stats).  ``n_closure`` is L, the
+    original edge count (the reference re-reads it from the *input* graph
+    every round, fc:144/:175 — so it is static).
+
+    ``ensemble_sharding`` (a ``NamedSharding`` with spec ``P("p")``) pins the
+    per-partition keys and labels to the mesh's ensemble axis; XLA then runs
+    each chip's shard of the ensemble locally and contracts the n_p axis of
+    the co-membership count with one ``psum`` — the round's only collective.
+    """
+    k_detect, k_closure = jax.random.split(key)
+    keys = prng.partition_keys(k_detect, n_p)
+    if ensemble_sharding is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        keys = jax.lax.with_sharding_constraint(keys, ensemble_sharding)
+        labels_sharding = NamedSharding(
+            ensemble_sharding.mesh,
+            PartitionSpec(*ensemble_sharding.spec, None))
+        labels = jax.lax.with_sharding_constraint(
+            detect(slab, keys), labels_sharding)
+    else:
+        labels = detect(slab, keys)
+    slab, stats = consensus_tail(slab, labels, k_closure, n_p, tau, delta,
+                                 n_closure)
     return slab, labels, stats
 
 
@@ -155,6 +169,51 @@ def _jitted_round(detect: Detector, n_p: int, tau: float, delta: float,
 @functools.lru_cache(maxsize=64)
 def _jitted_detect(detect: Detector):
     return jax.jit(detect)
+
+
+@functools.lru_cache(maxsize=128)
+def _jitted_tail(n_p: int, tau: float, delta: float, n_closure: int):
+    return jax.jit(functools.partial(
+        consensus_tail, n_p=n_p, tau=tau, delta=delta, n_closure=n_closure))
+
+
+def _members_per_call(slab: GraphSlab, n_p: int) -> int:
+    """How many ensemble members one detection device-call should carry.
+
+    A single XLA execution must stay well under the TPU tunnel's ~60 s
+    single-call ceiling (a longer execute kills the worker), and splitting
+    detection into several calls also keeps the driver responsive for
+    checkpoint/trace hooks.  The estimate uses the measured ~70 ns per
+    directed-edge entry per sweep of the current move kernels and ~96 sweeps
+    per detection (leiden runs three local-move phases), targeting ~15 s per
+    call for safety margin; FCTPU_DETECT_CALL_MEMBERS overrides (<= 0
+    disables splitting).
+    """
+    env = os.environ.get("FCTPU_DETECT_CALL_MEMBERS", "")
+    if env:
+        c = int(env)
+        return n_p if c <= 0 else min(c, n_p)
+    est_member_s = 96 * 2 * slab.capacity * 70e-9
+    return max(1, min(n_p, int(15.0 / max(est_member_s, 1e-9))))
+
+
+def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
+                    members: int) -> jax.Array:
+    """Run detection as ceil(n_p / members) separate device calls.
+
+    Labels stay on device; only the dispatches are split.  Chunks reuse one
+    compiled executable; an uneven remainder compiles a second shape once.
+    """
+    n_p = keys.shape[0]
+    jd = _jitted_detect(detect)
+    if members >= n_p:
+        return jd(slab, keys)
+    parts = [jd(slab, keys[i:i + members])
+             for i in range(0, (n_p // members) * members, members)]
+    rem = n_p % members
+    if rem:
+        parts.append(jd(slab, keys[n_p - rem:]))
+    return jnp.concatenate(parts, axis=0)
 
 
 class ConsensusResult(NamedTuple):
@@ -241,8 +300,14 @@ def run_consensus(slab: GraphSlab,
                 f"ensemble unsharded. Round n_p up with parallel.pad_n_p.",
                 stacklevel=2)
 
-    round_fn = _jitted_round(detect, config.n_p, config.tau, config.delta,
-                             n_closure, ensemble_sharding)
+    members = _members_per_call(slab, config.n_p)
+    split_phase = ensemble_sharding is None and members < config.n_p
+    if not split_phase:
+        round_fn = _jitted_round(detect, config.n_p, config.tau, config.delta,
+                                 n_closure, ensemble_sharding)
+    else:
+        tail_fn = _jitted_tail(config.n_p, config.tau, config.delta,
+                               n_closure)
 
     history: List[dict] = list(prior_history)
     converged = resumed_converged
@@ -250,7 +315,15 @@ def run_consensus(slab: GraphSlab,
     end_round = start_round if resumed_converged else config.max_rounds
     for r in range(start_round, end_round):
         k = prng.stream(key, prng.STREAM_ROUND, r)
-        slab, _, stats = round_fn(slab, k)
+        if split_phase:
+            # same key derivation as consensus_round, so split and one-call
+            # execution produce identical results
+            k_detect, k_closure = jax.random.split(k)
+            keys = prng.partition_keys(k_detect, config.n_p)
+            labels = _detect_chunked(detect, slab, keys, members)
+            slab, stats = tail_fn(slab, labels, k_closure)
+        else:
+            slab, _, stats = round_fn(slab, k)
         rounds = r + 1
         # One bulk device->host transfer for the whole stats tuple: per-field
         # scalar readbacks each pay the full device round-trip latency, which
@@ -288,7 +361,9 @@ def run_consensus(slab: GraphSlab,
         from fastconsensus_tpu.parallel import sharding as shard
 
         final_keys = shard.shard_keys(final_keys, mesh)
-    final_labels = _jitted_detect(detect)(slab, final_keys)
+        final_labels = _jitted_detect(detect)(slab, final_keys)
+    else:
+        final_labels = _detect_chunked(detect, slab, final_keys, members)
     # Single bulk readback of the [n_p, N] label matrix (per-row transfers
     # each pay the device round-trip; see the stats readback note above).
     all_labels = jax.device_get(final_labels)

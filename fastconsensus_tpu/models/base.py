@@ -15,6 +15,7 @@ community (co-membership is an equality test, ops/consensus_ops.py).
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Protocol
 
 import jax
@@ -26,10 +27,52 @@ class Detector(Protocol):
     def __call__(self, slab: GraphSlab, keys: jax.Array) -> jax.Array: ...
 
 
+def _sweep_bytes_per_member(slab: GraphSlab) -> int:
+    """Rough peak of one member's per-sweep temporaries.
+
+    Delegates to louvain's :func:`sweep_temp_bytes` (lazy import — louvain
+    imports this module), which consults the same path selection
+    :func:`local_move` will actually use, so the budget can't drift from the
+    kernels.
+    """
+    from fastconsensus_tpu.models import louvain
+
+    return louvain.sweep_temp_bytes(slab)
+
+
+def ensemble_chunk(slab: GraphSlab, n_p: int) -> int:
+    """How many ensemble members to run concurrently.
+
+    vmapping all n_p members multiplies every per-sweep temporary by n_p —
+    at LFR-10k shapes (N=10k, d_cap~1000) that is ~25 GB for n_p=100, past
+    any single chip's HBM.  Bound the concurrent slice so temps fit a budget
+    (FCTPU_ENSEMBLE_BUDGET_MB, default 2048), or force a chunk size with
+    FCTPU_ENSEMBLE_CHUNK (<=0 disables chunking, e.g. on multi-chip meshes
+    where the ensemble axis is already sharded across devices).
+    """
+    env = os.environ.get("FCTPU_ENSEMBLE_CHUNK", "")
+    if env:
+        c = int(env)
+        return n_p if c <= 0 else min(c, n_p)
+    budget = int(os.environ.get("FCTPU_ENSEMBLE_BUDGET_MB", "2048")) << 20
+    return max(1, min(n_p, budget // max(1, _sweep_bytes_per_member(slab))))
+
+
 def ensemble(single: Callable[[GraphSlab, jax.Array], jax.Array]) -> Detector:
-    """Lift a one-partition kernel to the n_p ensemble axis via vmap."""
+    """Lift a one-partition kernel to the n_p ensemble axis.
+
+    Plain vmap when all members' sweep temporaries fit the memory budget;
+    otherwise ``lax.map(..., batch_size=chunk)`` — sequential chunks of a
+    vmapped inner kernel, bounding peak HBM at chunk * per-member bytes
+    while keeping each chunk wide enough to saturate the chip.
+    """
 
     def detect(slab: GraphSlab, keys: jax.Array) -> jax.Array:
-        return jax.vmap(lambda k: single(slab, k))(keys)
+        n_p = keys.shape[0]
+        chunk = ensemble_chunk(slab, n_p)
+        if chunk >= n_p:
+            return jax.vmap(lambda k: single(slab, k))(keys)
+        return jax.lax.map(lambda k: single(slab, k), keys,
+                           batch_size=chunk)
 
     return detect

@@ -35,7 +35,7 @@ from fastconsensus_tpu.ops import segment as seg
 
 
 def refine(slab: GraphSlab, comm: jax.Array, key: jax.Array,
-           max_sweeps: int = 24) -> jax.Array:
+           max_sweeps: int = 16) -> jax.Array:
     """Constrained local move: singletons may only merge within ``comm``."""
     n = slab.n_nodes
     intra = slab.alive & (comm[jnp.clip(slab.src, 0, n - 1)] ==
@@ -45,7 +45,7 @@ def refine(slab: GraphSlab, comm: jax.Array, key: jax.Array,
 
 
 def leiden_single(slab: GraphSlab, key: jax.Array,
-                  max_sweeps: int = 48) -> jax.Array:
+                  max_sweeps: int = 32) -> jax.Array:
     n = slab.n_nodes
     k0, k1, k2 = jax.random.split(key, 3)
 
@@ -63,7 +63,7 @@ def leiden_single(slab: GraphSlab, key: jax.Array,
     return lvl[jnp.clip(refined, 0, n - 1)]
 
 
-def make_leiden(max_sweeps: int = 48) -> Detector:
+def make_leiden(max_sweeps: int = 32) -> Detector:
     return ensemble(functools.partial(leiden_single, max_sweeps=max_sweeps))
 
 

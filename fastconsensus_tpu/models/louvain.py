@@ -27,6 +27,7 @@ itself returns level-0 labels for parity with the reference.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Tuple
 
 import jax
@@ -37,13 +38,30 @@ from fastconsensus_tpu.models.base import Detector, ensemble
 from fastconsensus_tpu.ops import dense_adj as da
 from fastconsensus_tpu.ops import segment as seg
 
-_JITTER = 1e-5
+# Tie-break jitter and the move margin are *relative to the gain quantum*
+# 1/(2m): modularity gains differ by integer multiples of w_min/(2m), so an
+# absolute jitter amplitude would dwarf real gain differences on large graphs
+# (at 2m ~ 1e5 the quantum is ~1e-5) and vanish against them on tiny ones.
+# Jitter in [0, 0.25/2m) can only reorder exact ties; a jittered best
+# exceeding the unjittered stay score by 0.5/2m implies a true gain — without
+# that margin, nodes at equilibrium flip-flop on jitter noise forever, the
+# sweep loop never converges, and the churn degrades partition quality as
+# sweeps accumulate (measured on LFR-10k: NMI 0.59 at 24 sweeps falling to
+# 0.52 at 48).
+_JITTER_REL = 0.25
+_MARGIN_REL = 0.5
 
 # Widest graph the full-matrix (MXU) move path materializes: per ensemble
 # member the sweep holds a few N x N arrays, so n_p * N^2 * ~16B must fit in
 # HBM (n_p=200 at N=1024 is ~3 GB).  Larger graphs take the padded-row or
 # sorted-run paths.
 MATMUL_MAX_N = 1024
+
+# Dense padded-row sweeps beat hashed scatter-adds only while the row area
+# N*(d_cap+1) stays comparable to the directed-edge count (low degree skew);
+# past this ratio the rows are mostly padding and the per-sweep row sort
+# loses to O(E) scatters.
+DENSE_OVER_HASH = 8
 
 
 def _gain_runs(slab: GraphSlab, labels: jax.Array
@@ -74,11 +92,15 @@ def _gain_runs(slab: GraphSlab, labels: jax.Array
 
 
 def _move_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
-               m2: jax.Array, update_prob: float, gamma: float = 1.0
+               m2: jax.Array, gamma: float = 1.0
                ) -> Tuple[jax.Array, jax.Array]:
-    """One synchronous sweep.  Returns (new_labels, n_want_move)."""
+    """One synchronous sweep via the exact sorted-run reduction.
+
+    Returns ``(best_label, want)``; the caller (local_move) decides which
+    wanted moves to apply (swap-break masking).
+    """
     n = slab.n_nodes
-    k_tie, k_mask = jax.random.split(key)
+    k_tie = key
     runs, strength, sigma_tot = _gain_runs(slab, labels)
 
     k_i = strength[jnp.clip(runs.node, 0, n - 1)]
@@ -87,14 +109,20 @@ def _move_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
     # gain of node i joining C (with i removed from its current community):
     # k_i_in(C) - k_i * (Sigma_tot(C) - [i in C] k_i) / 2m
     gain = runs.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
-    score = gain + seg.uniform_jitter(k_tie, gain.shape, _JITTER)
+    score = gain + seg.uniform_jitter(k_tie, gain.shape, _JITTER_REL / m2)
 
-    best, _, has_any = seg.argmax_label_per_node(
+    best, best_score, has_any = seg.argmax_label_per_node(
         runs.node, score, runs.label, runs.valid, n)
-    want = has_any & (best != labels) & (best >= 0)
-    n_want = jnp.sum(want.astype(jnp.int32))
-    mask = jax.random.bernoulli(k_mask, update_prob, (n,))
-    return jnp.where(want & mask, best, labels), n_want
+    # unjittered stay score per node (the own-label run; nodes without one —
+    # no intra-community edge — fall back to the synthetic zero-weight run's
+    # gain, which _gain_runs guarantees exists)
+    stay = jax.ops.segment_max(
+        jnp.where(runs.valid & own, gain, -jnp.inf),
+        jnp.where(runs.valid & own, runs.node, n),
+        num_segments=n + 1)[:-1]
+    want = has_any & (best != labels) & (best >= 0) & \
+        (best_score > stay + _MARGIN_REL / m2)
+    return best, want
 
 
 def _dense_weights(slab: GraphSlab) -> jax.Array:
@@ -114,7 +142,7 @@ def _dense_weights(slab: GraphSlab) -> jax.Array:
 
 def _move_step_matmul(W: jax.Array, labels: jax.Array, key: jax.Array,
                       m2: jax.Array, strength: jax.Array,
-                      update_prob: float, gamma: float = 1.0
+                      gamma: float = 1.0
                       ) -> Tuple[jax.Array, jax.Array]:
     """One synchronous sweep via one MXU matmul (graphs with N <= MATMUL_MAX_N).
 
@@ -129,7 +157,7 @@ def _move_step_matmul(W: jax.Array, labels: jax.Array, key: jax.Array,
     deviation; such moves never have positive gain).
     """
     n = W.shape[0]
-    k_tie, k_mask = jax.random.split(key)
+    k_tie = key
     sigma_tot = jax.ops.segment_sum(
         strength, jnp.clip(labels, 0, n - 1), num_segments=n)
     onehot = jax.nn.one_hot(labels, n, dtype=W.dtype)
@@ -141,18 +169,83 @@ def _move_step_matmul(W: jax.Array, labels: jax.Array, key: jax.Array,
     gain = s - gamma * k_i * (
         sigma_tot[None, :] - jnp.where(own, k_i, 0.0)) / m2
     score = jnp.where((s > 0) | own,
-                      gain + seg.uniform_jitter(k_tie, gain.shape, _JITTER),
+                      gain + seg.uniform_jitter(k_tie, gain.shape,
+                                                _JITTER_REL / m2),
                       -jnp.inf)
     best = jnp.argmax(score, axis=1).astype(jnp.int32)
-    want = best != labels
-    n_want = jnp.sum(want.astype(jnp.int32))
-    mask = jax.random.bernoulli(k_mask, update_prob, (n,))
-    return jnp.where(want & mask, best, labels), n_want
+    best_score = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0]
+    stay = jnp.take_along_axis(gain, jnp.clip(labels, 0, n - 1)[:, None],
+                               axis=1)[:, 0]
+    want = (best != labels) & (best_score > stay + _MARGIN_REL / m2)
+    return best, want
+
+
+def _move_step_hash(slab: GraphSlab, labels: jax.Array, key: jax.Array,
+                    m2: jax.Array, strength: jax.Array, n_buckets: int,
+                    gamma: float = 1.0
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """One synchronous sweep via hashed scatter-adds — no sorts at all.
+
+    Every directed edge IS a move candidate (node -> neighbor's community);
+    its k_i_in(C) comes from two-table hashed accumulation
+    (ops/segment.py:HashTables), and the per-node argmax is two scatter-max
+    passes.  Work is O(E) per sweep regardless of degree skew — on
+    hub-heavy graphs (LFR mu=0.5: mean degree 12, max 518) this replaces the
+    dense path's [N, d_cap] sort over 99% padding.
+
+    Approximation (documented): a candidate colliding with another live
+    (node, label) pair in both tables reads an overstated k_i_in; probability
+    ~(E/B)^2 per pair at load factor <= 0.25, and keyed jitter already
+    randomizes near-ties, so move quality is unaffected in practice (NMI
+    parity vs the exact paths: tests/test_louvain.py::test_move_path_parity).
+
+    Every pair that is *looked up* must also be *inserted*: the stay lookup
+    (i, c_i) therefore gets a synthetic zero-weight entry exactly like
+    _gain_runs's synthetic run — an absent pair would otherwise read
+    min(t1, t2) over buckets owned by other pairs, overstating the stay
+    score without bound and freezing nodes in place (size n_buckets with
+    :func:`segment.hash_buckets_for`(2*capacity + n_nodes)).
+    """
+    n = slab.n_nodes
+    k_tie = key
+    sigma_tot = jax.ops.segment_sum(
+        strength, jnp.clip(labels, 0, n - 1), num_segments=n)
+    srcd, dstd, wd, ad = slab.directed()
+    valid = ad & (srcd != dstd)
+    src_c = jnp.clip(srcd, 0, n - 1)
+    lab_dst = labels[jnp.clip(dstd, 0, n - 1)]
+    nodes = jnp.arange(n, dtype=jnp.int32)
+
+    tables = seg.build_hash_totals(
+        jnp.concatenate([srcd, nodes]),
+        jnp.concatenate([lab_dst, labels]),
+        jnp.concatenate([wd, jnp.zeros((n,), jnp.float32)]),
+        jnp.concatenate([valid, jnp.ones((n,), bool)]),
+        n_buckets)
+    tot = seg.lookup_hash_totals(tables, srcd, lab_dst)
+    k_i = strength[src_c]
+    sig = sigma_tot[jnp.clip(lab_dst, 0, n - 1)]
+    own = lab_dst == labels[src_c]
+    gain = tot - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    score = jnp.where(valid, gain + seg.uniform_jitter(
+        k_tie, gain.shape, _JITTER_REL / m2), -jnp.inf)
+    best, best_score, has_any = seg.scatter_argmax_label(
+        srcd, score, lab_dst, valid, n)
+
+    # the "stay" candidate (always present in the tables via the synthetic
+    # zero-weight entry above); unjittered — see _MARGIN_REL
+    stay_tot = seg.lookup_hash_totals(tables, nodes, labels)
+    stay = stay_tot - gamma * strength * (sigma_tot[jnp.clip(labels, 0, n - 1)]
+                                          - strength) / m2
+
+    want = has_any & (best_score > stay + _MARGIN_REL / m2) & \
+        (best != labels) & (best >= 0)
+    return best, want
 
 
 def _move_step_dense(adj: da.DenseAdj, slab: GraphSlab, labels: jax.Array,
                      key: jax.Array, m2: jax.Array, strength: jax.Array,
-                     update_prob: float, gamma: float = 1.0
+                     gamma: float = 1.0
                      ) -> Tuple[jax.Array, jax.Array]:
     """One synchronous sweep on the padded dense adjacency.
 
@@ -162,7 +255,7 @@ def _move_step_dense(adj: da.DenseAdj, slab: GraphSlab, labels: jax.Array,
     sweep (see dense_adj module docstring).
     """
     n = slab.n_nodes
-    k_tie, k_mask = jax.random.split(key)
+    k_tie = key
     sigma_tot = jax.ops.segment_sum(
         strength, jnp.clip(labels, 0, n - 1), num_segments=n)
 
@@ -171,27 +264,107 @@ def _move_step_dense(adj: da.DenseAdj, slab: GraphSlab, labels: jax.Array,
     sig = sigma_tot[jnp.clip(tot.label, 0, n - 1)]
     own = tot.label == labels[:, None]
     gain = tot.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
-    jitter = seg.uniform_jitter(k_tie, gain.shape, _JITTER)
+    jitter = seg.uniform_jitter(k_tie, gain.shape, _JITTER_REL / m2)
     score = jnp.where(tot.is_head, gain + jitter, -jnp.inf)
 
     best, want = da.best_candidate(tot, score, labels)
-    n_want = jnp.sum(want.astype(jnp.int32))
-    mask = jax.random.bernoulli(k_mask, update_prob, (n,))
-    return jnp.where(want & mask, best, labels), n_want
+    best_score = jnp.max(score, axis=1)
+    stay = jnp.max(jnp.where(own & tot.is_head, gain, -jnp.inf), axis=1)
+    want = want & (best_score > stay + _MARGIN_REL / m2)
+    return best, want
+
+
+def _swap_break(key: jax.Array, slab: GraphSlab, want: jax.Array
+                ) -> jax.Array:
+    """Keep each wanting node only if it out-prioritizes its wanting neighbors.
+
+    Synchronous best-gain moves oscillate: adjacent node pairs that each
+    improve by joining the other's community swap forever when both move in
+    the same sweep (a bernoulli subsample only makes the swap *probable* per
+    sweep, so n_want floors at a few percent and never reaches 0 — measured
+    ~400/10k nodes after 48 sweeps on LFR-10k).  Random per-sweep priorities
+    make adjacent simultaneous moves impossible — the standard
+    independent-set cure from GPU Louvain (arXiv:1805.10904) — while nodes
+    with no wanting neighbor still move every sweep, so convergence speed for
+    the bulk is unchanged and n_want can actually hit 0.
+    """
+    n = slab.n_nodes
+    pri = jax.random.uniform(key, (n,))
+    srcd, dstd, _, ad = slab.directed()
+    valid = ad & (srcd != dstd)
+    wpri = jnp.where(want, pri, -1.0)
+    nbr_best = jnp.full((n + 1,), -1.0).at[
+        jnp.where(valid, srcd, n)].max(
+        wpri[jnp.clip(dstd, 0, n - 1)], mode="drop")[:-1]
+    return want & (wpri > nbr_best)
+
+
+def select_move_path(slab: GraphSlab) -> str:
+    """Which per-sweep lowering :func:`local_move` will use for this slab.
+
+    One of "matmul", "dense", "hash", "runs" — best first: full-matrix MXU
+    matmul for graphs up to MATMUL_MAX_N nodes; padded dense rows when the
+    slab carries a neighbor capacity (``d_cap > 0``) *and* the padded-row
+    area is within DENSE_OVER_HASH of the directed-edge count (skewed degree
+    distributions make the rows mostly padding, and the per-sweep row sort
+    pays for the padding); hashed scatter-add aggregation otherwise
+    (hub-heavy graphs and the d_cap=0 aggregated multi-level graphs).
+
+    FCTPU_MOVE_PATH forces a path, best-effort: a forced path that cannot
+    serve this slab (dense needs d_cap; matmul needs the N^2 matrix to fit —
+    capped at 8*MATMUL_MAX_N to keep a forced run from faulting the chip)
+    falls through to the exact sorted-run step ("runs", kept as the oracle
+    the approximate hash path is tested against).
+
+    The single source of truth for path choice — memory budgeting
+    (models/base.py:ensemble_chunk) consults it too.
+    """
+    n = slab.n_nodes
+    forced = os.environ.get("FCTPU_MOVE_PATH", "")
+    if forced:
+        if forced == "matmul" and n <= 8 * MATMUL_MAX_N:
+            return "matmul"
+        if forced == "dense" and slab.d_cap > 0:
+            return "dense"
+        if forced == "hash":
+            return "hash"
+        return "runs"
+    if n <= MATMUL_MAX_N:
+        return "matmul"
+    if slab.d_cap > 0 and \
+            n * (slab.d_cap + 1) <= DENSE_OVER_HASH * 2 * slab.capacity:
+        return "dense"
+    return "hash"
+
+
+def sweep_temp_bytes(slab: GraphSlab) -> int:
+    """Rough peak of one ensemble member's per-sweep temporaries.
+
+    Feeds the ensemble-width budget (models/base.py:ensemble_chunk); the
+    constant factors are deliberately generous.
+    """
+    path = select_move_path(slab)
+    n = slab.n_nodes
+    if path == "matmul":
+        return 4 * 4 * n * n
+    if path == "dense":
+        return 6 * 4 * n * (slab.d_cap + 1)
+    # hash / runs: a handful of directed-edge-sized arrays (sort operands or
+    # scatter sources) plus, for hash, the two bucket tables
+    return 10 * 4 * 2 * slab.capacity + \
+        2 * 4 * seg.hash_buckets_for(2 * slab.capacity + n)
 
 
 def local_move(slab: GraphSlab, key: jax.Array,
                init_labels: jax.Array = None,
-               max_sweeps: int = 48, update_prob: float = 0.5,
+               max_sweeps: int = 32, update_prob: float = 0.5,
                gamma: float = 1.0) -> jax.Array:
     """Run sweeps until no node can improve (or max_sweeps).  Labels are
     community ids in [0, N); not compacted.
 
-    Path selection, best first: full-matrix MXU matmul for graphs up to
-    MATMUL_MAX_N nodes; padded dense rows when the slab carries a neighbor
-    capacity (``d_cap > 0``, set by pack_edges); exact sorted-run reduction
-    otherwise (aggregated multi-level graphs, hub-heavy degree
-    distributions).
+    Per-sweep lowering: :func:`select_move_path`.  ``update_prob`` is the
+    probability a wanted move is applied during the early chaotic phase
+    (the endgame switches to swap-break masking; see the body comment).
     """
     n = slab.n_nodes
     if init_labels is None:
@@ -199,14 +372,17 @@ def local_move(slab: GraphSlab, key: jax.Array,
     srcd, _, wd, ad = slab.directed()
     m2 = jnp.maximum(jnp.sum(jnp.where(ad, wd, 0.0)), 1e-9)
 
-    matmul = n <= MATMUL_MAX_N
-    dense = not matmul and slab.d_cap > 0
+    path = select_move_path(slab)
+    matmul = path == "matmul"
+    dense = path == "dense"
+    hashed = path == "hash"
+    strength = slab.strengths()
     if matmul:
         W = _dense_weights(slab)
-        strength = slab.strengths()
     elif dense:
         adj = da.build_dense_adjacency(slab)
-        strength = slab.strengths()
+    elif hashed:
+        n_buckets = seg.hash_buckets_for(2 * slab.capacity + n)
 
     def cond(state):
         _, it, n_want = state
@@ -214,17 +390,31 @@ def local_move(slab: GraphSlab, key: jax.Array,
 
     def body(state):
         labels, it, _ = state
-        k = jax.random.fold_in(key, it)
+        k_step, k_pri, k_mask = jax.random.split(
+            jax.random.fold_in(key, it), 3)
         if matmul:
-            new_labels, n_want = _move_step_matmul(
-                W, labels, k, m2, strength, update_prob, gamma)
+            best, want = _move_step_matmul(
+                W, labels, k_step, m2, strength, gamma)
         elif dense:
-            new_labels, n_want = _move_step_dense(
-                adj, slab, labels, k, m2, strength, update_prob, gamma)
+            best, want = _move_step_dense(
+                adj, slab, labels, k_step, m2, strength, gamma)
+        elif hashed:
+            best, want = _move_step_hash(
+                slab, labels, k_step, m2, strength, n_buckets, gamma)
         else:
-            new_labels, n_want = _move_step(slab, labels, k, m2, update_prob,
-                                            gamma)
-        return new_labels, it + 1, n_want
+            best, want = _move_step(slab, labels, k_step, m2, gamma)
+        n_want = jnp.sum(want.astype(jnp.int32))
+        # Adaptive masking: while many nodes want to move (early, chaotic
+        # phase) a bernoulli(update_prob) subsample merges fastest — swap
+        # collisions are rare and harmless among thousands of movers.  Near
+        # convergence the same subsample lets adjacent pairs swap forever,
+        # so the endgame switches to priority swap-breaking, which makes
+        # adjacent simultaneous moves impossible and lets n_want actually
+        # reach 0.
+        bern = jax.random.bernoulli(k_mask, update_prob, (n,))
+        endgame = n_want <= jnp.int32(max(1, int(0.05 * n)))
+        mask = jnp.where(endgame, _swap_break(k_pri, slab, want), bern)
+        return jnp.where(want & mask, best, labels), it + 1, n_want
 
     labels, _, _ = jax.lax.while_loop(
         cond, body, (init_labels, jnp.int32(0), jnp.int32(1)))
@@ -253,7 +443,7 @@ def aggregate(slab: GraphSlab, labels: jax.Array) -> GraphSlab:
 
 
 def modularity_levels(slab: GraphSlab, key: jax.Array, n_levels: int = 2,
-                      max_sweeps: int = 48, update_prob: float = 0.5
+                      max_sweeps: int = 32, update_prob: float = 0.5
                       ) -> jax.Array:
     """Multi-level optimization; returns the *flattened* final labels.
 
@@ -276,7 +466,7 @@ def modularity_levels(slab: GraphSlab, key: jax.Array, n_levels: int = 2,
 
 
 def louvain_single(slab: GraphSlab, key: jax.Array,
-                   max_sweeps: int = 48, update_prob: float = 0.5,
+                   max_sweeps: int = 32, update_prob: float = 0.5,
                    gamma: float = 1.0) -> jax.Array:
     """Level-0 partition (parity with partition_at_level(dend, 0), fc:148).
 
@@ -288,7 +478,7 @@ def louvain_single(slab: GraphSlab, key: jax.Array,
                    update_prob=update_prob, gamma=gamma), slab.n_nodes)
 
 
-def make_louvain(max_sweeps: int = 48, update_prob: float = 0.5,
+def make_louvain(max_sweeps: int = 32, update_prob: float = 0.5,
                  gamma: float = 1.0) -> Detector:
     return ensemble(functools.partial(
         louvain_single, max_sweeps=max_sweeps, update_prob=update_prob,

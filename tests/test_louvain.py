@@ -107,3 +107,74 @@ def test_leiden_karate_quality(karate_slab, karate_truth):
         labels = np.asarray(leiden_single(karate_slab, jax.random.key(s)))
         qs.append(modularity(u, v, w, labels))
     assert max(qs) > 0.35, f"leiden modularity {qs}"
+
+
+def test_hash_totals_exact_without_collisions():
+    # tiny candidate set, huge table: collisions impossible -> exact totals
+    from fastconsensus_tpu.ops import segment as seg
+
+    node = jnp.array([0, 0, 1, 1, 1, 2, 0], jnp.int32)
+    label = jnp.array([5, 5, 5, 7, 7, 9, 9], jnp.int32)
+    value = jnp.array([1., 2., 4., 8., 16., 32., 64.], jnp.float32)
+    valid = jnp.array([1, 1, 1, 1, 1, 1, 0], bool)  # last entry masked
+    tables = seg.build_hash_totals(node, label, value, valid, 1 << 16)
+    got = np.asarray(seg.lookup_hash_totals(tables, node, label))
+    np.testing.assert_allclose(got[:6], [3., 3., 4., 24., 24., 32.])
+    # absent pair reads 0 (both buckets empty at this load)
+    absent = seg.lookup_hash_totals(
+        tables, jnp.array([3], jnp.int32), jnp.array([5], jnp.int32))
+    assert float(absent[0]) == 0.0
+
+
+def test_scatter_argmax_matches_sorted_argmax():
+    from fastconsensus_tpu.ops import segment as seg
+
+    rng = np.random.default_rng(0)
+    e, n = 500, 40
+    node = jnp.asarray(rng.integers(0, n, e), jnp.int32)
+    label = jnp.asarray(rng.integers(0, 17, e), jnp.int32)
+    score = jnp.asarray(rng.normal(size=e), jnp.float32)
+    valid = jnp.asarray(rng.random(e) < 0.8)
+    a_lab, a_sc, a_has = seg.scatter_argmax_label(node, score, label, valid, n)
+    b_lab, b_sc, b_has = seg.argmax_label_per_node(node, score, label, valid, n)
+    np.testing.assert_array_equal(np.asarray(a_has), np.asarray(b_has))
+    np.testing.assert_allclose(np.asarray(a_sc)[np.asarray(a_has)],
+                               np.asarray(b_sc)[np.asarray(b_has)])
+    np.testing.assert_array_equal(np.asarray(a_lab), np.asarray(b_lab))
+
+
+def test_move_path_parity(monkeypatch):
+    """The approximate hash path must match the exact paths at NMI level
+    (models/louvain.py hash-path docstring)."""
+    from fastconsensus_tpu.utils.synth import planted_partition
+
+    edges, truth = planted_partition(400, 8, 0.3, 0.01, seed=3)
+    slab = pack_edges(edges, 400)
+    keys = jax.random.split(jax.random.key(0), 4)
+    scores = {}
+    for path in ("matmul", "hash", "runs"):
+        monkeypatch.setenv("FCTPU_MOVE_PATH", path)
+        labels = np.asarray(jax.vmap(
+            lambda k: louvain_single(slab, k))(keys))
+        scores[path] = float(np.mean([nmi(l, truth) for l in labels]))
+    assert scores["hash"] > 0.9, scores
+    assert abs(scores["hash"] - scores["runs"]) < 0.08, scores
+
+
+def test_select_move_path_forced_fallbacks(monkeypatch):
+    import dataclasses
+
+    from fastconsensus_tpu.models import louvain as lv
+
+    edges = np.array([[0, 1], [1, 2], [2, 3]])
+    slab = pack_edges(edges, 4)
+    assert lv.select_move_path(slab) == "matmul"
+    nocap = dataclasses.replace(slab, d_cap=0)
+    monkeypatch.setenv("FCTPU_MOVE_PATH", "dense")
+    assert lv.select_move_path(nocap) == "runs"  # dense impossible
+    monkeypatch.setenv("FCTPU_MOVE_PATH", "hash")
+    assert lv.select_move_path(nocap) == "hash"
+    # forced matmul on a huge-N slab must not materialize N^2 — falls back
+    monkeypatch.setenv("FCTPU_MOVE_PATH", "matmul")
+    big = dataclasses.replace(slab, n_nodes=100_000, d_cap=0)
+    assert lv.select_move_path(big) == "runs"

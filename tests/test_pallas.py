@@ -59,6 +59,20 @@ def test_row_totals_matches_sort_path():
             assert abs(ra[k] - rb[k]) < 1e-4
 
 
+def test_fits_vmem_guards_wide_rows():
+    # Narrow rows (the common case) stay on the Pallas path; wide rows must
+    # not: at the Mosaic-minimum 8-row block the [8, D', D'] compare temps
+    # exceed VMEM past D ~ 500 and fault the TPU worker (regression: the
+    # LFR-10k config, d_cap=1036).
+    assert pk.fits_vmem(128)
+    assert pk.fits_vmem(256)
+    assert not pk.fits_vmem(1037)
+    assert not pk.fits_vmem(4096)
+    # padded width is what counts: 513 pads to 640 -> 8*6*640^2 = 19.7MB
+    assert pk.fits_vmem(512)
+    assert not pk.fits_vmem(513)
+
+
 def test_row_totals_padding_and_sentinels():
     # ragged: 5 rows, width 7 (pads to 128 lanes, 32-row blocks)
     lab = jnp.array([[1, 1, 2, pk.SENTINEL, 2, 1, 3]] * 5, jnp.int32)
