@@ -29,10 +29,36 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 
-def _louvain_labels(g, seed: int) -> Dict[int, int]:
+def _detect_labels(g, algorithm: str, seed: int) -> Dict[int, int]:
+    """One base-detection run via the closest networkx equivalent.
+
+    louvain/leiden -> louvain_communities (leidenalg is absent; both are
+    modularity maximizers), lpm -> asyn_lpa_communities (igraph's async LPA
+    counterpart), cnm -> greedy_modularity_communities (same CNM greedy
+    agglomeration as igraph's community_fastgreedy), infomap -> louvain
+    (networkx has no map-equation implementation; documented deviation).
+    """
     import networkx as nx
 
-    comms = nx.community.louvain_communities(g, weight="weight", seed=seed)
+    if algorithm == "lpm":
+        comms = list(nx.community.asyn_lpa_communities(
+            g, weight="weight", seed=seed))
+    elif algorithm == "cnm":
+        # greedy_modularity_communities is deterministic; the reference
+        # injects ensemble randomness by randomly relabeling the graph per
+        # run (fast_consensus.py:319-335) — mirror that here, else all n_p
+        # ensemble members are identical and the consensus is degenerate.
+        rng = random.Random(seed)
+        perm = list(g.nodes())
+        rng.shuffle(perm)
+        fwd = {node: i for i, node in enumerate(perm)}
+        relabeled = nx.relabel_nodes(g, fwd, copy=True)
+        comms = [{perm[i] for i in comm}
+                 for comm in nx.community.greedy_modularity_communities(
+                     relabeled, weight="weight")]
+    else:  # louvain / leiden / infomap
+        comms = nx.community.louvain_communities(g, weight="weight",
+                                                 seed=seed)
     labels: Dict[int, int] = {}
     for i, comm in enumerate(comms):
         for node in comm:
@@ -46,9 +72,10 @@ def cpu_consensus(edges: np.ndarray,
                   tau: float = 0.2,
                   delta: float = 0.02,
                   seed: int = 0,
-                  max_rounds: int = 64
+                  max_rounds: int = 64,
+                  algorithm: str = "louvain"
                   ) -> Tuple[List[np.ndarray], int]:
-    """Reference-equivalent louvain fast consensus on networkx.
+    """Reference-equivalent fast consensus on networkx.
 
     Mirrors fast_consensus.py:129-201 (louvain path) with SURVEY.md §2.22's
     corrected semantics: proper co-membership accumulation (no
@@ -69,7 +96,7 @@ def cpu_consensus(edges: np.ndarray,
     rounds = 0
     for _ in range(max_rounds):
         rounds += 1
-        parts = [_louvain_labels(graph, rng.randrange(2**31))
+        parts = [_detect_labels(graph, algorithm, rng.randrange(2**31))
                  for _ in range(n_p)]
         nextgraph = graph.copy()
         # co-membership counts restricted to existing edges (fc:150-159)
@@ -109,7 +136,7 @@ def cpu_consensus(edges: np.ndarray,
 
     final = []
     for _ in range(n_p):
-        labels = _louvain_labels(graph, rng.randrange(2**31))
+        labels = _detect_labels(graph, algorithm, rng.randrange(2**31))
         final.append(np.array([labels.get(i, 0) for i in range(n_nodes)],
                               dtype=np.int64))
     return final, rounds

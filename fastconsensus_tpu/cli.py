@@ -47,6 +47,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--alg", metavar="alg", type=str, default="louvain",
                    choices=ALGORITHMS,
                    help=f"one of {', '.join(ALGORITHMS)}")
+    p.add_argument("-g", dest="gamma", metavar="gamma", type=float,
+                   default=1.0,
+                   help="resolution parameter for modularity detectors "
+                        "(the reference parses -g but ignores it, "
+                        "merged_consensus.py:284-285; here it works)")
     p.add_argument("--seed", type=int, default=0,
                    help="PRNG seed for the whole run (default: 0)")
     p.add_argument("--max-rounds", type=int, default=64,
@@ -100,8 +105,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error reading {args.f}: {e}", file=sys.stderr)
         return 2
 
+    from fastconsensus_tpu.models.registry import supports_param
+
     try:
-        detector = get_detector(args.alg)
+        if args.gamma != 1.0 and not supports_param(args.alg, "gamma"):
+            print(f"warning: -g {args.gamma} ignored for --alg {args.alg} "
+                  f"(resolution applies to modularity detectors)",
+                  file=sys.stderr)
+        detector = get_detector(args.alg, gamma=args.gamma)
     except (ValueError, NotImplementedError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2

@@ -204,16 +204,33 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
     Labels stay on device; only the dispatches are split.  Chunks reuse one
     compiled executable; an uneven remainder compiles a second shape once.
     """
+    import logging
+    import time as _time
+
+    logger = logging.getLogger("fastconsensus_tpu")
     n_p = keys.shape[0]
     jd = _jitted_detect(detect)
     if members >= n_p:
         return jd(slab, keys)
-    parts = [jd(slab, keys[i:i + members])
-             for i in range(0, (n_p // members) * members, members)]
-    rem = n_p % members
-    if rem:
-        parts.append(jd(slab, keys[n_p - rem:]))
-    return jnp.concatenate(parts, axis=0)
+    # Pad to a whole number of equal chunks: one compiled shape for every
+    # call (a ragged remainder would pay a second multi-minute remote
+    # compile for at most `members-1` members of work).
+    n_calls = -(-n_p // members)
+    pad = n_calls * members - n_p
+    if pad:
+        # gather (typed PRNG key arrays don't implement .repeat)
+        idx = jnp.concatenate([jnp.arange(n_p, dtype=jnp.int32),
+                               jnp.full((pad,), n_p - 1, jnp.int32)])
+        keys = keys[idx]
+    parts = []
+    for i in range(n_calls):
+        t0 = _time.perf_counter()
+        out = jd(slab, keys[i * members:(i + 1) * members])
+        out.block_until_ready()
+        logger.debug("detect call %d/%d (%d members): %.1fs",
+                     i + 1, n_calls, members, _time.perf_counter() - t0)
+        parts.append(out)
+    return jnp.concatenate(parts, axis=0)[:n_p]
 
 
 class ConsensusResult(NamedTuple):

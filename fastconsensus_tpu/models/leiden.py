@@ -35,36 +35,44 @@ from fastconsensus_tpu.ops import segment as seg
 
 
 def refine(slab: GraphSlab, comm: jax.Array, key: jax.Array,
-           max_sweeps: int = 16) -> jax.Array:
+           max_sweeps: int = 16, gamma: float = 1.0) -> jax.Array:
     """Constrained local move: singletons may only merge within ``comm``."""
     n = slab.n_nodes
     intra = slab.alive & (comm[jnp.clip(slab.src, 0, n - 1)] ==
                           comm[jnp.clip(slab.dst, 0, n - 1)])
     masked = dataclasses.replace(slab, alive=intra)
-    return local_move(masked, key, max_sweeps=max_sweeps)
+    return local_move(masked, key, max_sweeps=max_sweeps, gamma=gamma)
 
 
 def leiden_single(slab: GraphSlab, key: jax.Array,
-                  max_sweeps: int = 32) -> jax.Array:
+                  max_sweeps: int = 32, gamma: float = 1.0) -> jax.Array:
     n = slab.n_nodes
     k0, k1, k2 = jax.random.split(key, 3)
 
-    comm = local_move(slab, k0, max_sweeps=max_sweeps)
-    refined = seg.compact_labels(refine(slab, comm, k1), n)
+    comm = local_move(slab, k0, max_sweeps=max_sweeps, gamma=gamma)
+    # refinement re-partitions *within* converged communities — a much
+    # easier problem than the main move phase, so half the sweep budget
+    # suffices (quality-checked in tests/test_louvain.py leiden tests)
+    refined = seg.compact_labels(
+        refine(slab, comm, k1, max_sweeps=max(max_sweeps // 2, 4),
+               gamma=gamma), n)
 
     # aggregate over refined groups; initialize the aggregate's partition at
-    # the unrefined communities (each refined group inherits its community)
+    # the unrefined communities (each refined group inherits its community).
+    # The aggregate starts from an already-converged assignment, so it too
+    # needs only the half budget.
     agg = aggregate(slab, refined)
     group_comm = jax.ops.segment_max(
         comm, jnp.clip(refined, 0, n - 1), num_segments=n)
     lvl = local_move(agg, k2, init_labels=group_comm.astype(jnp.int32),
-                     max_sweeps=max_sweeps)
+                     max_sweeps=max(max_sweeps // 2, 4), gamma=gamma)
     lvl = seg.compact_labels(lvl, n)
     return lvl[jnp.clip(refined, 0, n - 1)]
 
 
-def make_leiden(max_sweeps: int = 32) -> Detector:
-    return ensemble(functools.partial(leiden_single, max_sweeps=max_sweeps))
+def make_leiden(max_sweeps: int = 32, gamma: float = 1.0) -> Detector:
+    return ensemble(functools.partial(leiden_single, max_sweeps=max_sweeps,
+                                      gamma=gamma))
 
 
 leiden = make_leiden()

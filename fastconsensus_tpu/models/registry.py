@@ -9,6 +9,8 @@ engine — the extension point BASELINE.json's north star names.
 
 from __future__ import annotations
 
+import functools as _functools
+import inspect as _inspect
 from typing import Callable, Dict
 
 from fastconsensus_tpu.models.base import Detector
@@ -23,15 +25,44 @@ def register(name: str):
     return deco
 
 
-def get_detector(name: str) -> Detector:
+def supports_param(name: str, param: str) -> bool:
+    """Whether ``name``'s registered factory accepts keyword ``param``
+    (e.g. "gamma") — lets callers warn instead of silently dropping it."""
     try:
         factory = _REGISTRY[name]
     except KeyError:
         raise ValueError(
             f"unknown algorithm {name!r}; available: {sorted(_REGISTRY)}"
         ) from None
+    return param in _inspect.signature(factory).parameters
+
+
+def get_detector(name: str, gamma: float = 1.0) -> Detector:
+    """Resolve a detector; memoized so repeated lookups return the same
+    function object (jit caches key on it — see consensus._jitted_round).
+
+    Extra parameters (currently ``gamma``, the resolution parameter) are
+    forwarded to the registered factory when its signature accepts them, so
+    new detectors opt in by declaring the keyword — no name lists here.
+    The reference parses ``-g`` but never uses it
+    (merged_consensus.py:284-285, SURVEY.md §2.22.10); here it works.
+    """
+    return _get_cached(name, float(gamma))
+
+
+@_functools.lru_cache(maxsize=64)
+def _get_cached(name: str, gamma: float) -> Detector:
     try:
-        return factory()
+        factory = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown algorithm {name!r}; available: {sorted(_REGISTRY)}"
+        ) from None
+    kwargs = {}
+    if "gamma" in _inspect.signature(factory).parameters:
+        kwargs["gamma"] = gamma
+    try:
+        return factory(**kwargs)
     except ImportError as e:
         raise NotImplementedError(
             f"algorithm {name!r} is registered but its kernel is not "
@@ -49,15 +80,15 @@ def _lpm() -> Detector:
 
 
 @register("louvain")
-def _louvain() -> Detector:
-    from fastconsensus_tpu.models.louvain import louvain
-    return louvain
+def _louvain(gamma: float = 1.0) -> Detector:
+    from fastconsensus_tpu.models.louvain import louvain, make_louvain
+    return louvain if gamma == 1.0 else make_louvain(gamma=gamma)
 
 
 @register("leiden")
-def _leiden() -> Detector:
-    from fastconsensus_tpu.models.leiden import leiden
-    return leiden
+def _leiden(gamma: float = 1.0) -> Detector:
+    from fastconsensus_tpu.models.leiden import leiden, make_leiden
+    return leiden if gamma == 1.0 else make_leiden(gamma=gamma)
 
 
 @register("cnm")

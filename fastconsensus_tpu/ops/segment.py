@@ -130,12 +130,26 @@ def lookup_hash_totals(tables: HashTables, node: jax.Array, label: jax.Array
     return jnp.minimum(tables.t1[h1], tables.t2[h2])
 
 
-def hash_buckets_for(n_entries: int, cap: int = 1 << 23) -> int:
-    """Power-of-two table size ~4x the live-pair bound (load factor <= 0.25)."""
+def hash_buckets_for(n_entries: int, cap: int = 1 << 26) -> int:
+    """Power-of-two table size ~4x the live-pair bound (load factor <= 0.25).
+
+    ``cap`` (default 64M buckets = 256 MB/table) bounds the two tables' HBM
+    footprint; a graph large enough to hit it (> ~16M live pairs) loses the
+    documented ~(E/B)^2 collision bound, so the cap engaging is logged —
+    quality on such graphs should be validated against an exact path.
+    """
     b = 1
     while b < 4 * max(1, n_entries):
         b <<= 1
-    return min(b, cap)
+    if b > cap:
+        import logging
+
+        logging.getLogger("fastconsensus_tpu").warning(
+            "hash table capped at %d buckets for %d entries (load factor "
+            "%.2f > 0.25): collision rate exceeds the documented bound",
+            cap, n_entries, n_entries / cap)
+        return cap
+    return b
 
 
 def scatter_argmax_label(node: jax.Array, score: jax.Array, label: jax.Array,

@@ -178,3 +178,18 @@ def test_select_move_path_forced_fallbacks(monkeypatch):
     monkeypatch.setenv("FCTPU_MOVE_PATH", "matmul")
     big = dataclasses.replace(slab, n_nodes=100_000, d_cap=0)
     assert lv.select_move_path(big) == "runs"
+
+
+def test_gamma_resolution_changes_granularity():
+    # higher resolution -> more, smaller communities (mc's -g, made to work)
+    from fastconsensus_tpu.models.registry import get_detector
+
+    edges, n, truth = ring_of_cliques(6, 5)
+    slab = pack_edges(edges, n)
+    keys = jax.random.split(jax.random.key(0), 2)
+    lo = np.asarray(get_detector("louvain", gamma=0.05)(slab, keys))
+    hi = np.asarray(get_detector("louvain", gamma=8.0)(slab, keys))
+    assert len(np.unique(hi[0])) > len(np.unique(lo[0]))
+    # same (name, gamma) resolves to the same cached function object
+    assert get_detector("louvain", gamma=8.0) is \
+        get_detector("louvain", gamma=8.0)
