@@ -96,12 +96,19 @@ def measure_baseline(name, cfg, edges, n_nodes, truth):
     from fastconsensus_tpu.baselines.cpu_reference import time_cpu_consensus
     from fastconsensus_tpu.utils.metrics import nmi
 
-    # Cap the CPU run for the big configs: baseline n_p scaled down and the
-    # metric normalized per-partition, so the ratio stays apples-to-apples.
-    n_p = min(cfg["n_p"], 20 if cfg.get("n", 0) > 5000 else cfg["n_p"])
+    # Cap the CPU run for the big configs: baseline n_p (and, at 100k scale,
+    # rounds) scaled down and the metric normalized per-partition.  Fewer
+    # rounds means *less* consensus work per partition, so the cap can only
+    # make the baseline look faster — the reported ratio is conservative.
+    n = cfg.get("n", 0)
+    n_p = min(cfg["n_p"], 20 if n > 5000 else cfg["n_p"])
+    kw = {}
+    if n > 50_000:
+        n_p = min(n_p, 4)
+        kw["max_rounds"] = 2
     secs, parts, rounds = time_cpu_consensus(
         edges, n_nodes, n_p=n_p, tau=cfg["tau"], delta=cfg["delta"], seed=0,
-        algorithm=cfg["alg"])
+        algorithm=cfg["alg"], **kw)
     entry = {
         "partitions_per_sec": n_p / secs,
         "nmi": float(nmi(parts[0], truth)),

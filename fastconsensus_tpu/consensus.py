@@ -171,6 +171,64 @@ def _jitted_detect(detect: Detector):
     return jax.jit(detect)
 
 
+def consensus_rounds_block(slab: GraphSlab,
+                           key: jax.Array,
+                           start_round: jax.Array,
+                           max_iters: jax.Array,
+                           detect: Detector,
+                           n_p: int,
+                           tau: float,
+                           delta: float,
+                           n_closure: int,
+                           block: int
+                           ) -> Tuple[GraphSlab, jax.Array, RoundStats]:
+    """Up to ``min(block, max_iters)`` consensus rounds in ONE device call.
+
+    On small graphs a round's device time is a few hundred ms, so the
+    per-round host round-trip (dispatch + stats readback over the TPU
+    tunnel) dominates the driver loop; a ``lax.while_loop`` over whole
+    rounds amortizes it ``block``-fold.  Stops early on delta-convergence.
+    ``max_iters`` is traced (the driver's remaining-round budget never
+    triggers a recompile).  Returns (slab, n_rounds_done, stacked
+    stats[block]); entries past n_rounds_done are garbage and must be
+    ignored.  ``key`` is the run key: per-round keys are derived from
+    (key, start_round + i) exactly as the one-round driver derives them, so
+    block size never changes results.
+    """
+    from fastconsensus_tpu.utils import prng as _prng
+
+    def empty_stats():
+        z = jnp.zeros((block,), jnp.int32)
+        return RoundStats(converged=jnp.zeros((block,), bool), n_alive=z,
+                          n_unconverged=z, n_closure_added=z, n_repaired=z,
+                          n_dropped=z, n_overflow=z)
+
+    def cond(carry):
+        _, i, conv, _ = carry
+        return (~conv) & (i < block) & (i < max_iters)
+
+    def body(carry):
+        slab, i, _, buf = carry
+        k = _prng.stream(key, _prng.STREAM_ROUND, start_round + i)
+        slab, _, st = consensus_round(slab, k, detect=detect, n_p=n_p,
+                                      tau=tau, delta=delta,
+                                      n_closure=n_closure)
+        buf = jax.tree.map(lambda b, s: b.at[i].set(s), buf, st)
+        return slab, i + 1, st.converged, buf
+
+    slab, done, _, buf = jax.lax.while_loop(
+        cond, body, (slab, jnp.int32(0), jnp.bool_(False), empty_stats()))
+    return slab, done, buf
+
+
+@functools.lru_cache(maxsize=128)
+def _jitted_rounds_block(detect: Detector, n_p: int, tau: float, delta: float,
+                         n_closure: int, block: int):
+    return jax.jit(functools.partial(
+        consensus_rounds_block, detect=detect, n_p=n_p, tau=tau, delta=delta,
+        n_closure=n_closure, block=block))
+
+
 @functools.lru_cache(maxsize=128)
 def _jitted_tail(n_p: int, tau: float, delta: float, n_closure: int):
     return jax.jit(functools.partial(
@@ -183,9 +241,9 @@ def _members_per_call(slab: GraphSlab, n_p: int) -> int:
     A single XLA execution must stay well under the TPU tunnel's ~60 s
     single-call ceiling (a longer execute kills the worker), and splitting
     detection into several calls also keeps the driver responsive for
-    checkpoint/trace hooks.  The estimate uses the measured ~70 ns per
-    directed-edge entry per sweep of the current move kernels and ~96 sweeps
-    per detection (leiden runs three local-move phases), targeting ~15 s per
+    checkpoint/trace hooks.  Per-member time comes from
+    :func:`_est_member_seconds` (sweep-temporary bytes x the measured
+    per-move-path cost table ``_NS_PER_TEMP_BYTE``), targeting ~15 s per
     call for safety margin; FCTPU_DETECT_CALL_MEMBERS overrides (<= 0
     disables splitting).
     """
@@ -193,8 +251,24 @@ def _members_per_call(slab: GraphSlab, n_p: int) -> int:
     if env:
         c = int(env)
         return n_p if c <= 0 else min(c, n_p)
-    est_member_s = 96 * 2 * slab.capacity * 70e-9
-    return max(1, min(n_p, int(15.0 / max(est_member_s, 1e-9))))
+    return max(1, min(n_p, int(15.0 / max(_est_member_seconds(slab), 1e-9))))
+
+
+# Measured effective cost per byte of per-sweep temporaries, by move path
+# (TPU v5e via the dev tunnel): the matmul path streams (MXU/HBM-bound),
+# dense pays the row sort / pallas compare, hash and runs are
+# scatter/sort-bound.  Calibrated against lfr1k (matmul), planted-100k
+# (dense) and lfr10k (hash) detections.
+_NS_PER_TEMP_BYTE = {"matmul": 0.02, "dense": 0.2, "hash": 0.8, "runs": 1.5}
+
+
+def _est_member_seconds(slab: GraphSlab) -> float:
+    """Crude per-ensemble-member detection time estimate for call sizing."""
+    from fastconsensus_tpu.models import louvain
+
+    path = louvain.select_move_path(slab)
+    return (96 * louvain.sweep_temp_bytes(slab)
+            * _NS_PER_TEMP_BYTE[path] * 1e-9)
 
 
 def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
@@ -319,33 +393,30 @@ def run_consensus(slab: GraphSlab,
 
     members = _members_per_call(slab, config.n_p)
     split_phase = ensemble_sharding is None and members < config.n_p
-    if not split_phase:
+    # Fused-rounds mode: when a whole round is cheap (small graphs, no
+    # sharded mesh, no per-round checkpointing), run blocks of rounds in a
+    # single device call — the per-round dispatch + stats-readback latency
+    # through the TPU tunnel otherwise dominates the driver loop.  Block
+    # size targets ~15 s per call; 1 disables fusion.
+    est_round_s = _est_member_seconds(slab) * config.n_p
+    fused_block = 1
+    if not split_phase and checkpoint_path is None and mesh is None:
+        fused_block = max(1, min(8, int(15.0 / max(est_round_s, 1e-9))))
+    if fused_block > 1:
+        block_fn = _jitted_rounds_block(
+            detect, config.n_p, config.tau, config.delta, n_closure,
+            fused_block)
+    elif not split_phase:
         round_fn = _jitted_round(detect, config.n_p, config.tau, config.delta,
                                  n_closure, ensemble_sharding)
     else:
         tail_fn = _jitted_tail(config.n_p, config.tau, config.delta,
                                n_closure)
 
-    history: List[dict] = list(prior_history)
-    converged = resumed_converged
-    rounds = start_round
-    end_round = start_round if resumed_converged else config.max_rounds
-    for r in range(start_round, end_round):
-        k = prng.stream(key, prng.STREAM_ROUND, r)
-        if split_phase:
-            # same key derivation as consensus_round, so split and one-call
-            # execution produce identical results
-            k_detect, k_closure = jax.random.split(k)
-            keys = prng.partition_keys(k_detect, config.n_p)
-            labels = _detect_chunked(detect, slab, keys, members)
-            slab, stats = tail_fn(slab, labels, k_closure)
-        else:
-            slab, _, stats = round_fn(slab, k)
-        rounds = r + 1
-        # One bulk device->host transfer for the whole stats tuple: per-field
-        # scalar readbacks each pay the full device round-trip latency, which
-        # through the TPU tunnel dwarfs the round's compute (measured).
-        stats = jax.device_get(stats)
+    def record(stats) -> bool:
+        """Append one round's (host-side) stats; returns converged."""
+        nonlocal rounds, converged
+        rounds += 1
         entry = {
             "round": rounds,
             "n_alive": int(stats.n_alive),
@@ -359,18 +430,55 @@ def run_consensus(slab: GraphSlab,
         if on_round is not None:
             on_round(entry)
         converged = bool(stats.converged)
-        if checkpoint_path is not None and \
-                (rounds % checkpoint_every == 0 or converged):
-            from fastconsensus_tpu.utils import checkpoint as ckpt
+        return converged
 
-            ckpt.save_checkpoint(
-                checkpoint_path, slab, rounds,
-                np.asarray(jax.random.key_data(key)), history,
-                extra={"algorithm": config.algorithm, "n_p": config.n_p,
-                       "tau": config.tau, "delta": config.delta,
-                       "converged": converged})
-        if converged:
-            break
+    history: List[dict] = list(prior_history)
+    converged = resumed_converged
+    rounds = start_round
+    end_round = start_round if resumed_converged else config.max_rounds
+    r = start_round
+    while r < end_round:
+        if fused_block > 1:
+            slab, done, buf = block_fn(slab, key, jnp.int32(r),
+                                       jnp.int32(end_round - r))
+            done = int(done)
+            buf = jax.device_get(buf)
+            for i in range(done):
+                if record(jax.tree.map(lambda b: b[i], buf)):
+                    break
+            r += done
+            if converged:
+                break
+        else:
+            k = prng.stream(key, prng.STREAM_ROUND, r)
+            if split_phase:
+                # same key derivation as consensus_round, so split and
+                # one-call execution produce identical results
+                k_detect, k_closure = jax.random.split(k)
+                keys = prng.partition_keys(k_detect, config.n_p)
+                labels = _detect_chunked(detect, slab, keys, members)
+                slab, stats = tail_fn(slab, labels, k_closure)
+            else:
+                slab, _, stats = round_fn(slab, k)
+            r += 1
+            # One bulk device->host transfer for the whole stats tuple:
+            # per-field scalar readbacks each pay the full device
+            # round-trip latency, which through the TPU tunnel dwarfs the
+            # round's compute (measured).
+            stats = jax.device_get(stats)
+            record(stats)
+            if checkpoint_path is not None and \
+                    (rounds % checkpoint_every == 0 or converged):
+                from fastconsensus_tpu.utils import checkpoint as ckpt
+
+                ckpt.save_checkpoint(
+                    checkpoint_path, slab, rounds,
+                    np.asarray(jax.random.key_data(key)), history,
+                    extra={"algorithm": config.algorithm, "n_p": config.n_p,
+                           "tau": config.tau, "delta": config.delta,
+                           "converged": converged})
+            if converged:
+                break
 
     final_keys = prng.partition_keys(
         prng.stream(key, prng.STREAM_FINAL), config.n_p)

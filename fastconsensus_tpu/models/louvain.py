@@ -274,8 +274,8 @@ def _move_step_dense(adj: da.DenseAdj, slab: GraphSlab, labels: jax.Array,
     return best, want
 
 
-def _swap_break(key: jax.Array, slab: GraphSlab, want: jax.Array
-                ) -> jax.Array:
+def _swap_break(key: jax.Array, slab: GraphSlab, want: jax.Array,
+                adj: "da.DenseAdj" = None) -> jax.Array:
     """Keep each wanting node only if it out-prioritizes its wanting neighbors.
 
     Synchronous best-gain moves oscillate: adjacent node pairs that each
@@ -290,12 +290,23 @@ def _swap_break(key: jax.Array, slab: GraphSlab, want: jax.Array
     """
     n = slab.n_nodes
     pri = jax.random.uniform(key, (n,))
-    srcd, dstd, _, ad = slab.directed()
-    valid = ad & (srcd != dstd)
     wpri = jnp.where(want, pri, -1.0)
-    nbr_best = jnp.full((n + 1,), -1.0).at[
-        jnp.where(valid, srcd, n)].max(
-        wpri[jnp.clip(dstd, 0, n - 1)], mode="drop")[:-1]
+    if adj is not None:
+        # dense rows: per-row max over neighbor priorities — far cheaper
+        # than the directed-edge scatter (measured 123 ms -> ~25 ms on the
+        # 100k config).  Overflowed hub rows may miss a wanting neighbor
+        # beyond d_cap (the same candidates the move step itself does not
+        # see); a missed swap-break there only delays convergence by a
+        # sweep, never corrupts state.
+        nbrp = jnp.where(adj.valid,
+                         wpri[jnp.clip(adj.nbr, 0, n - 1)], -1.0)
+        nbr_best = jnp.max(nbrp, axis=1)
+    else:
+        srcd, dstd, _, ad = slab.directed()
+        valid = ad & (srcd != dstd)
+        nbr_best = jnp.full((n + 1,), -1.0).at[
+            jnp.where(valid, srcd, n)].max(
+            wpri[jnp.clip(dstd, 0, n - 1)], mode="drop")[:-1]
     return want & (wpri > nbr_best)
 
 
@@ -411,9 +422,14 @@ def local_move(slab: GraphSlab, key: jax.Array,
         # so the endgame switches to priority swap-breaking, which makes
         # adjacent simultaneous moves impossible and lets n_want actually
         # reach 0.
-        bern = jax.random.bernoulli(k_mask, update_prob, (n,))
         endgame = n_want <= jnp.int32(max(1, int(0.05 * n)))
-        mask = jnp.where(endgame, _swap_break(k_pri, slab, want), bern)
+        # Both mask variants are computed and selected with where: a
+        # lax.cond here gets batched into select_n under the ensemble vmap
+        # (both branches execute regardless) and only adds overhead
+        # (measured +70% on the 100k config).
+        bern = jax.random.bernoulli(k_mask, update_prob, (n,))
+        swap = _swap_break(k_pri, slab, want, adj if dense else None)
+        mask = jnp.where(endgame, swap, bern)
         return jnp.where(want & mask, best, labels), it + 1, n_want
 
     labels, _, _ = jax.lax.while_loop(

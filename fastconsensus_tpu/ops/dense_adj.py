@@ -17,9 +17,11 @@ aggregation over a **fixed-width padded adjacency** ``[N, D]``:
 Rows wider than ``d_cap`` lose their overflow edges from *candidate
 generation only* (the slab itself — co-membership counts, thresholds,
 convergence — is untouched); ``build_dense_adjacency`` reports the dropped
-count so callers can surface it.  ``pack_edges`` sizes ``d_cap`` at twice
-the input max degree, so overflow only appears if triadic closure more than
-doubles a hub's degree.
+count so callers can surface it.  ``pack_edges`` sizes ``d_cap`` at 1.25x
+the input max degree (the per-sweep cost is quadratic in the padded width,
+see graph.py), so overflow appears once triadic closure grows a hub's
+degree past that slack; consensus_round surfaces it per round
+(RoundStats.n_overflow).
 """
 
 from __future__ import annotations

@@ -82,3 +82,36 @@ def test_consensus_graph_stays_within_capacity():
     assert res.graph.capacity == slab.capacity  # static shapes end to end
     for h in res.history:
         assert h["n_alive"] <= slab.capacity
+
+
+def test_fused_rounds_match_single_rounds(monkeypatch):
+    """Blocked device-side rounds derive per-round keys identically, so
+    fusion must never change results (consensus.py:consensus_rounds_block)."""
+    import numpy as np
+
+    from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
+    from fastconsensus_tpu.graph import pack_edges
+    from fastconsensus_tpu.models.registry import get_detector
+    from fastconsensus_tpu.utils.synth import planted_partition
+
+    edges, _ = planted_partition(120, 4, 0.4, 0.02, seed=9)
+    slab = pack_edges(edges, 120)
+    cfg = ConsensusConfig(algorithm="lpm", n_p=6, tau=0.5, delta=0.02,
+                          max_rounds=5, seed=3)
+    det = get_detector("lpm")
+
+    monkeypatch.setenv("FCTPU_DETECT_CALL_MEMBERS", "0")  # no splitting
+    fused = run_consensus(slab, det, cfg)
+
+    # force per-round execution by making the round estimate enormous
+    from fastconsensus_tpu import consensus as cmod
+    monkeypatch.setitem(cmod._NS_PER_TEMP_BYTE, "matmul", 1e6)
+    single = run_consensus(slab, det, cfg)
+
+    assert fused.rounds == single.rounds
+    assert fused.converged == single.converged
+    assert len(fused.history) == len(single.history)
+    for a, b in zip(fused.history, single.history):
+        assert a == b
+    for pa, pb in zip(fused.partitions, single.partitions):
+        np.testing.assert_array_equal(pa, pb)
