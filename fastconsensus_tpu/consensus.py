@@ -272,11 +272,20 @@ def _est_member_seconds(slab: GraphSlab) -> float:
 
 
 def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
-                    members: int) -> jax.Array:
+                    members: int,
+                    cache_dir: Optional[str] = None,
+                    cache_tag: str = "") -> jax.Array:
     """Run detection as ceil(n_p / members) separate device calls.
 
     Labels stay on device; only the dispatches are split.  Chunks reuse one
     compiled executable; an uneven remainder compiles a second shape once.
+
+    ``cache_dir``: elastic recovery for long runs.  Each completed chunk's
+    labels are persisted as ``{cache_dir}/{cache_tag}_c{i}.npy``; a
+    restarted run (the TPU tunnel wedges multi-hundred-call sequences, see
+    utils/trace.py notes) skips straight past finished chunks instead of
+    redetecting them.  Results are identical either way — chunk keys are
+    position-derived.
     """
     import logging
     import time as _time
@@ -298,11 +307,30 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
         keys = keys[idx]
     parts = []
     for i in range(n_calls):
+        path = None
+        if cache_dir:
+            path = os.path.join(cache_dir, f"{cache_tag}_c{i}.npy")
+            if os.path.exists(path):
+                cached = np.load(path)
+                if cached.shape != (members, slab.n_nodes):
+                    raise ValueError(
+                        f"stale detect-chunk cache {path}: shape "
+                        f"{cached.shape}, expected "
+                        f"{(members, slab.n_nodes)}; clean the cache dir")
+                parts.append(jnp.asarray(cached))
+                logger.debug("detect call %d/%d: loaded from %s",
+                             i + 1, n_calls, path)
+                continue
         t0 = _time.perf_counter()
         out = jd(slab, keys[i * members:(i + 1) * members])
         out.block_until_ready()
         logger.debug("detect call %d/%d (%d members): %.1fs",
                      i + 1, n_calls, members, _time.perf_counter() - t0)
+        if path is not None:
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:  # np.save would append .npy to tmp
+                np.save(fh, np.asarray(out))
+            os.replace(tmp, path)
         parts.append(out)
     return jnp.concatenate(parts, axis=0)[:n_p]
 
@@ -323,7 +351,8 @@ def run_consensus(slab: GraphSlab,
                   checkpoint_path: Optional[str] = None,
                   checkpoint_every: int = 1,
                   resume: bool = False,
-                  on_round=None) -> ConsensusResult:
+                  on_round=None,
+                  detect_cache_dir: Optional[str] = None) -> ConsensusResult:
     """Host-side driver: iterate jitted rounds to delta-convergence.
 
     With ``mesh`` (a ``jax.sharding.Mesh`` from parallel/sharding.py) the
@@ -337,10 +366,34 @@ def run_consensus(slab: GraphSlab,
     an existing checkpoint restarts the loop where it left off (the reference
     loses everything on interruption, SURVEY.md §5).  ``on_round`` is an
     observability hook called with each round's stats dict (utils/trace.py).
+
+    ``detect_cache_dir``: finer-grained elastic recovery for split-phase
+    runs — each completed detection chunk persists under this directory
+    (tagged with a config+seed fingerprint and the round), so a killed and
+    restarted process (same config/seed, ``resume=True`` + checkpoint for
+    the round state) re-detects only unfinished chunks.  Pair with
+    ``checkpoint_path``; clean the directory between unrelated runs.
     """
     if key is None:
         key = jax.random.key(config.seed)
     n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
+
+    cache_fp = ""
+    if detect_cache_dir:
+        import hashlib
+
+        os.makedirs(detect_cache_dir, exist_ok=True)
+        # members is part of the fingerprint: a retry with a different
+        # chunking (the natural response to tunnel trouble) must not load
+        # mis-sized chunks; max_rounds guards the `_final` tag (a capped
+        # run's final detection is of a different consensus graph).
+        # Detector hyper-parameters (e.g. gamma) are NOT captured — use a
+        # fresh cache dir when varying them (documented above).
+        cache_fp = hashlib.sha1(repr(
+            (config.algorithm, config.n_p, config.tau, config.delta,
+             config.seed, config.max_rounds, slab.n_nodes, slab.capacity,
+             _members_per_call(slab, config.n_p))
+        ).encode()).hexdigest()[:10]
 
     start_round = 0
     prior_history: List[dict] = []
@@ -456,7 +509,9 @@ def run_consensus(slab: GraphSlab,
                 # one-call execution produce identical results
                 k_detect, k_closure = jax.random.split(k)
                 keys = prng.partition_keys(k_detect, config.n_p)
-                labels = _detect_chunked(detect, slab, keys, members)
+                labels = _detect_chunked(detect, slab, keys, members,
+                                         cache_dir=detect_cache_dir,
+                                         cache_tag=f"{cache_fp}_r{r}")
                 slab, stats = tail_fn(slab, labels, k_closure)
             else:
                 slab, _, stats = round_fn(slab, k)
@@ -488,7 +543,9 @@ def run_consensus(slab: GraphSlab,
         final_keys = shard.shard_keys(final_keys, mesh)
         final_labels = _jitted_detect(detect)(slab, final_keys)
     else:
-        final_labels = _detect_chunked(detect, slab, final_keys, members)
+        final_labels = _detect_chunked(detect, slab, final_keys, members,
+                                       cache_dir=detect_cache_dir,
+                                       cache_tag=f"{cache_fp}_final")
     # Single bulk readback of the [n_p, N] label matrix (per-row transfers
     # each pay the device round-trip; see the stats readback note above).
     all_labels = jax.device_get(final_labels)

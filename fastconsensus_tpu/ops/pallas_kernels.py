@@ -59,6 +59,122 @@ def _row_totals_kernel(lab_ref, w_ref, total_ref, head_ref):
     head_ref[...] = (~dup_earlier) & real
 
 
+def _hash_jitter(row0: jax.Array, d: int, salt: jax.Array) -> jax.Array:
+    """Deterministic per-(row, slot) uniform in [0, 1): multiply-xorshift of
+    (global row id, slot, salt).  Cheaper than materializing a jax.random
+    draw in HBM for every candidate; used only to break ties."""
+    bn = row0.shape[0] if hasattr(row0, "shape") else 1
+    # f32 -> i32 -> u32: Mosaic has no direct f32->u32 cast; values are
+    # <= 2^24 so the detour is exact.  row0 IS the global row id (scal[:,5]
+    # carries arange(n)); adding a block-local iota on top would make rows
+    # in adjacent blocks collide to identical jitter vectors.
+    i = jnp.broadcast_to(
+        row0.astype(jnp.int32).astype(jnp.uint32)[:, None], (bn, d))
+    j = jax.lax.broadcasted_iota(jnp.uint32, (bn, d), 1)
+    m = i * jnp.uint32(0x9E3779B1) + j * jnp.uint32(0x85EBCA77) + salt
+    m = m ^ (m >> 15)
+    m = m * jnp.uint32(0x2C1B3C6D)
+    m = m ^ (m >> 13)
+    # top 23 bits -> i32 -> f32 (no direct u32->f32 cast in Mosaic)
+    return (m >> 9).astype(jnp.int32).astype(jnp.float32) * \
+        jnp.float32(2.0 ** -23)
+
+
+def _fused_move_kernel(lab_ref, w_ref, sig_ref, scal_ref,
+                       best_ref, want_ref, *, d_self: int):
+    """One whole move-step sweep for a block of dense rows.
+
+    Row layout: slots 0..d_self-1 are neighbors, slot d_self is the node's
+    own zero-weight candidate, the rest is SENTINEL padding.  ``scal`` rows
+    pack per-row scalars: [k_i, coef (= gamma*k_i/2m), jitter scale,
+    margin, salt, global row id, 0...].  Emits best label + want per row;
+    totals/heads/gains never leave VMEM (the unfused pipeline wrote and
+    re-read several [N, D] arrays per sweep).
+    """
+    lab = lab_ref[...]                       # int32[BN, D]
+    w = w_ref[...]                           # float32[BN, D]
+    sig = sig_ref[...]                       # float32[BN, D]
+    scal = scal_ref[...]                     # float32[BN, 8]
+    bn, d = lab.shape
+
+    eq = lab[:, :, None] == lab[:, None, :]  # [BN, i, j]
+    total = jnp.sum(jnp.where(eq, w[:, None, :], 0.0), axis=2)
+    i_idx = jax.lax.broadcasted_iota(jnp.int32, eq.shape, 1)
+    j_idx = jax.lax.broadcasted_iota(jnp.int32, eq.shape, 2)
+    head = ~jnp.any(eq & (j_idx < i_idx), axis=2)
+    real = lab != SENTINEL
+
+    k_i = scal[:, 0:1]
+    coef = scal[:, 1:2]
+    jscale = scal[:, 2:3]
+    margin = scal[:, 3:4]
+    salt = scal[0, 4].astype(jnp.int32).astype(jnp.uint32)
+    row0 = scal[:, 5]
+
+    own_lab = lab[:, d_self][:, None]        # int32[BN, 1]
+    own = lab == own_lab
+    gain = total - coef * (sig - jnp.where(own, k_i, 0.0))
+    jit = _hash_jitter(row0, d, salt) * jscale
+    neg = jnp.float32(-jnp.inf)
+    score = jnp.where(head & real, gain + jit, neg)
+
+    best_score = jnp.max(score, axis=1)
+    # no per-row gather in Mosaic: recover the argmax label by masked max
+    # (ties toward the larger label, like the sorted/scatter paths)
+    is_best = score == best_score[:, None]
+    best_lab = jnp.max(jnp.where(is_best & head & real, lab, -1), axis=1)
+    stay = jnp.max(jnp.where(own & head & real, gain, neg), axis=1)
+    has = best_score > neg
+    want = has & (best_lab != own_lab[:, 0]) & \
+        (best_score > stay + margin[:, 0])
+    best_ref[...] = jnp.where(has, best_lab, own_lab[:, 0])[:, None]
+    want_ref[...] = want[:, None]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("d_self", "block_n", "interpret"))
+def fused_move_rows(lab: jax.Array, w: jax.Array, sig: jax.Array,
+                    scal: jax.Array, d_self: int,
+                    block_n: int = None, interpret: bool = None
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Fused dense move sweep: (best int32[N], want bool[N]).
+
+    Inputs are pre-padded to lane width by the caller (models/louvain.py's
+    dense step builds them once per sweep); ``scal`` is float32[N, 8] as
+    documented on the kernel.  Same VMEM sizing rule as :func:`row_totals`.
+    """
+    n, d = lab.shape
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_n is None:
+        budget = 4 * 1024 * 1024
+        block_n = max(1, min(32, budget // (6 * d * d)))
+        if not interpret:
+            block_n = max(8, block_n - block_n % 8)
+    n_pad = (-n) % block_n
+    if n_pad:
+        lab = jnp.pad(lab, ((0, n_pad), (0, 0)), constant_values=SENTINEL)
+        w = jnp.pad(w, ((0, n_pad), (0, 0)))
+        sig = jnp.pad(sig, ((0, n_pad), (0, 0)))
+        scal = jnp.pad(scal, ((0, n_pad), (0, 0)))
+    np_ = lab.shape[0]
+
+    grid = (np_ // block_n,)
+    spec = pl.BlockSpec((block_n, d), lambda i: (i, 0))
+    sspec = pl.BlockSpec((block_n, scal.shape[1]), lambda i: (i, 0))
+    ospec = pl.BlockSpec((block_n, 1), lambda i: (i, 0))
+    best, want = pl.pallas_call(
+        functools.partial(_fused_move_kernel, d_self=d_self),
+        grid=grid,
+        in_specs=[spec, spec, spec, sspec],
+        out_specs=[ospec, ospec],
+        out_shape=[jax.ShapeDtypeStruct((np_, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((np_, 1), jnp.bool_)],
+        interpret=interpret,
+    )(lab, w, sig, scal)
+    return best[:n, 0], want[:n, 0]
+
+
 @functools.partial(jax.jit, static_argnames=("block_n", "interpret"))
 def row_totals(lab: jax.Array, w: jax.Array,
                block_n: int = None, interpret: bool = None

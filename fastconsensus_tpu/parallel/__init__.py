@@ -1,6 +1,7 @@
 from fastconsensus_tpu.parallel.sharding import (  # noqa: F401
     EDGE_AXIS,
     ENSEMBLE_AXIS,
+    initialize_multihost,
     keys_sharding,
     labels_sharding,
     make_mesh,

@@ -109,3 +109,27 @@ def shard_keys(keys: jax.Array, mesh: Mesh) -> jax.Array:
             f"n_p={keys.shape[0]} not divisible by ensemble axis "
             f"{mesh.shape[ENSEMBLE_AXIS]}; use pad_n_p")
     return jax.device_put(keys, keys_sharding(mesh))
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> int:
+    """Join a multi-host run; returns this process's index.
+
+    The reference's only scale-out is a fork+pickle pool on one machine
+    (fast_consensus.py:210-211).  Here multi-host needs no custom backend
+    either: ``jax.distributed.initialize`` brings every host's chips into
+    one global device set, ``make_mesh`` (which already uses the *global*
+    ``jax.devices()``) lays both axes across them, and the same
+    ``NamedSharding`` annotations that ride ICI within a slice ride DCN
+    across hosts — XLA's SPMD partitioner picks the transport, not us.
+
+    Args default from the standard cluster-env variables
+    (COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID, or the TPU pod
+    metadata on Cloud TPU).  Call once, before any jax computation.
+    Single-process runs may skip this entirely.
+    """
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return jax.process_index()
