@@ -14,4 +14,20 @@ the consensus round is one jitted function built from segment reductions.
 from fastconsensus_tpu.graph import GraphSlab, pack_edges, host_edges
 from fastconsensus_tpu.version import __version__
 
-__all__ = ["GraphSlab", "pack_edges", "host_edges", "__version__"]
+__all__ = ["GraphSlab", "pack_edges", "host_edges", "fast_consensus",
+           "run_consensus", "ConsensusConfig", "get_detector",
+           "__version__"]
+
+
+def __getattr__(name):
+    # Lazy top-level API: importing the package must stay cheap (no jax
+    # tracing) for CLI --help and host-only tooling.
+    if name in ("fast_consensus", "run_consensus", "ConsensusConfig"):
+        from fastconsensus_tpu import consensus
+
+        return getattr(consensus, name)
+    if name == "get_detector":
+        from fastconsensus_tpu.models.registry import get_detector
+
+        return get_detector
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -64,6 +64,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="persist consensus state to PATH after every round")
     p.add_argument("--resume", action="store_true",
                    help="resume from --checkpoint if it exists")
+    p.add_argument("--detect-cache", type=str, default=None, metavar="DIR",
+                   help="persist completed detection chunks under DIR so a "
+                        "killed run resumes mid-round (pair with "
+                        "--checkpoint/--resume; use a fresh DIR per "
+                        "configuration)")
     p.add_argument("--trace-jsonl", type=str, default=None, metavar="PATH",
                    help="append per-round stats records to a JSONL file")
     p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
@@ -129,7 +134,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         result = run_consensus(slab, detector, cfg,
                                checkpoint_path=args.checkpoint,
                                resume=args.resume,
-                               on_round=tracer.on_round)
+                               on_round=tracer.on_round,
+                               detect_cache_dir=args.detect_cache)
     elapsed = time.perf_counter() - t0
 
     if not args.quiet:

@@ -1,0 +1,132 @@
+"""Failure detection + restart supervision for long accelerator runs.
+
+The reference has no failure story at all: a crash loses everything
+(SURVEY.md §5 "failure detection / elastic recovery: none").  Here the
+layers compose:
+
+* per-round state -> utils/checkpoint.py (``--checkpoint`` / ``--resume``),
+* per-detection-chunk labels -> consensus.py ``detect_cache_dir``
+  (``--detect-cache``),
+* and this module: run a command under a *stall watchdog* — if its
+  progress file stops advancing (the TPU tunnel wedges multi-hundred-call
+  RPC sequences with no error, simply hanging the client), kill the
+  process, wait out the transport recovery, and rerun.  With the two
+  persistence layers above, each rerun resumes within the round it died
+  in, so total lost work per failure is bounded by one detection chunk.
+
+CLI: ``python -m fastconsensus_tpu.utils.supervise --progress rounds.jsonl
+-- python -m fastconsensus_tpu.cli -f g.txt --checkpoint ck.npz --resume
+--detect-cache cache --trace-jsonl rounds.jsonl ...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import List, Optional, Sequence
+
+
+def run_supervised(argv: Sequence[str],
+                   progress_path: str,
+                   stall_seconds: float = 300.0,
+                   recover_seconds: float = 90.0,
+                   max_attempts: int = 10,
+                   poll_seconds: float = 5.0,
+                   log=print) -> int:
+    """Run ``argv`` until it exits 0, restarting on stall or failure.
+
+    A *stall* is ``stall_seconds`` without the progress file's mtime (or
+    size) advancing; the child is then killed (SIGKILL — a wedged RPC
+    ignores SIGTERM) and, after ``recover_seconds`` for the transport to
+    recover, rerun.  Returns the final exit code (0 on success, the last
+    child's code otherwise).
+    """
+    import signal
+
+    def progress_sig() -> Optional[tuple]:
+        try:
+            st = os.stat(progress_path)
+            return (st.st_mtime, st.st_size)
+        except OSError:
+            return None
+
+    def kill_tree(child) -> None:
+        # the command may be a wrapper (bash, python -m ...); killing only
+        # the direct child would orphan the real worker, which then keeps
+        # the device transport and output files busy across retries
+        try:
+            os.killpg(child.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            child.kill()
+        child.wait()
+
+    rc = 1
+    for attempt in range(1, max_attempts + 1):
+        log(f"[supervise] attempt {attempt}/{max_attempts}: "
+            f"{' '.join(argv)}")
+        start = time.monotonic()
+        child = subprocess.Popen(list(argv), start_new_session=True)
+        last_sig = progress_sig()
+        # any observed change (including the file disappearing) refreshes
+        # the stall clock; before the first change the clock runs from
+        # launch (first-round compiles are slow; callers set stall_seconds
+        # above their compile budget)
+        last_change = start
+        seen_change = False
+        killed = False
+        while True:
+            rc = child.poll()
+            if rc is not None:
+                break
+            time.sleep(poll_seconds)
+            sig = progress_sig()
+            now = time.monotonic()
+            if sig != last_sig:
+                last_sig, last_change = sig, now
+                seen_change = True
+            ref = last_change if seen_change else start
+            if now - ref > stall_seconds:
+                log(f"[supervise] stalled {now - ref:.0f}s "
+                    f"(no progress on {progress_path}); killing")
+                kill_tree(child)
+                killed = True
+                rc = -9
+                break
+        if rc == 0:
+            log(f"[supervise] success on attempt {attempt}")
+            return 0
+        log(f"[supervise] attempt {attempt} ended rc={rc}"
+            f"{' (stall-killed)' if killed else ''}")
+        if attempt < max_attempts:
+            log(f"[supervise] waiting {recover_seconds:.0f}s before retry")
+            time.sleep(recover_seconds)
+    return rc
+
+
+def main(args: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m fastconsensus_tpu.utils.supervise",
+        description="Stall-watchdog supervisor for long runs (see module "
+                    "docstring).  Everything after `--` is the command.")
+    p.add_argument("--progress", required=True,
+                   help="file whose mtime/size advancing counts as progress "
+                        "(e.g. the run's --trace-jsonl)")
+    p.add_argument("--stall-seconds", type=float, default=300.0)
+    p.add_argument("--recover-seconds", type=float, default=90.0)
+    p.add_argument("--max-attempts", type=int, default=10)
+    ns, rest = p.parse_known_args(args)
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    if not rest:
+        p.error("no command given (put it after `--`)")
+    return run_supervised(rest, ns.progress,
+                          stall_seconds=ns.stall_seconds,
+                          recover_seconds=ns.recover_seconds,
+                          max_attempts=ns.max_attempts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

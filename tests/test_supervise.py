@@ -1,0 +1,43 @@
+"""Stall-watchdog supervisor (utils/supervise.py): failure-detection layer."""
+
+import os
+import sys
+
+from fastconsensus_tpu.utils.supervise import run_supervised
+
+
+def test_success_passes_through(tmp_path):
+    prog = tmp_path / "p.txt"
+    rc = run_supervised(
+        [sys.executable, "-c",
+         f"open({str(prog)!r}, 'w').write('x')"],
+        str(prog), stall_seconds=30, recover_seconds=0, poll_seconds=0.1,
+        log=lambda *a: None)
+    assert rc == 0
+
+
+def test_retry_until_success(tmp_path):
+    # first attempt fails, second succeeds (state via a marker file)
+    prog = tmp_path / "p.txt"
+    marker = tmp_path / "m"
+    script = (
+        "import os, sys\n"
+        f"open({str(prog)!r}, 'a').write('tick')\n"
+        f"if not os.path.exists({str(marker)!r}):\n"
+        f"    open({str(marker)!r}, 'w').close()\n"
+        "    sys.exit(3)\n")
+    rc = run_supervised([sys.executable, "-c", script], str(prog),
+                        stall_seconds=30, recover_seconds=0.1,
+                        poll_seconds=0.1, log=lambda *a: None)
+    assert rc == 0
+    assert marker.exists()
+
+
+def test_stall_kill_and_give_up(tmp_path):
+    # child never writes progress and sleeps forever -> killed each attempt
+    prog = tmp_path / "p.txt"
+    rc = run_supervised(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        str(prog), stall_seconds=1.0, recover_seconds=0.1,
+        poll_seconds=0.2, max_attempts=2, log=lambda *a: None)
+    assert rc == -9
