@@ -13,8 +13,10 @@ The CPU baseline is measured once and cached in ``BENCH_BASELINE.json`` so
 repeated driver runs only pay for the accelerator path.
 
 Environment knobs:
-  FCTPU_BENCH_CONFIG   lfr1k (default) | lfr10k | planted100k
+  FCTPU_BENCH_CONFIG   lfr1k (default) | karate | lfr10k | emailEu |
+                       planted100k   (the five BASELINE.md eval configs)
   FCTPU_BENCH_FORCE_BASELINE=1   re-measure the CPU baseline
+  FCTPU_BENCH_VERBOSE=1          per-round + per-detect-call tracing
 
 Output: ONE JSON line
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
