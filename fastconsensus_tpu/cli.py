@@ -56,6 +56,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="PRNG seed for the whole run (default: 0)")
     p.add_argument("--max-rounds", type=int, default=64,
                    help="safety cap on consensus rounds (default: 64)")
+    p.add_argument("--capacity", type=int, default=None, metavar="E_CAP",
+                   help="edge-slab capacity (default: 2*E+16). Size up for "
+                        "dense consensus graphs where triadic closure "
+                        "saturates the slab (watch the per-round 'dropped' "
+                        "count). Changing it invalidates an existing "
+                        "--checkpoint (capacity is part of the compiled "
+                        "shapes): restart the run fresh")
     p.add_argument("--out-dir", type=str, default=".",
                    help="directory to create output trees in (default: .)")
     p.add_argument("--quiet", action="store_true",
@@ -122,7 +129,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 2
 
-    slab = pack_edges(edges, n_nodes=len(original_ids))
+    try:
+        slab = pack_edges(edges, n_nodes=len(original_ids),
+                          capacity=args.capacity)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     cfg = ConsensusConfig(algorithm=args.alg, n_p=args.n_p, tau=args.tau,
                           delta=args.delta, max_rounds=args.max_rounds,
                           seed=args.seed)
@@ -130,20 +142,28 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     tracer = RoundTracer(jsonl_path=args.trace_jsonl)
     t0 = time.perf_counter()
-    with profiler_trace(args.profile_dir):
-        result = run_consensus(slab, detector, cfg,
-                               checkpoint_path=args.checkpoint,
-                               resume=args.resume,
-                               on_round=tracer.on_round,
-                               detect_cache_dir=args.detect_cache)
+    try:
+        with profiler_trace(args.profile_dir):
+            result = run_consensus(slab, detector, cfg,
+                                   checkpoint_path=args.checkpoint,
+                                   resume=args.resume,
+                                   on_round=tracer.on_round,
+                                   detect_cache_dir=args.detect_cache)
+    except ValueError as e:
+        # checkpoint/config mismatch (incl. a changed --capacity) or a
+        # stale detect cache — an operator error, not a crash
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     elapsed = time.perf_counter() - t0
 
     if not args.quiet:
         for h in result.history:
+            dropped = (f", {h['n_dropped']} dropped (capacity; see "
+                       f"--capacity)" if h["n_dropped"] else "")
             print(f"round {h['round']}: {h['n_alive']} edges, "
                   f"{h['n_unconverged']} unconverged, "
                   f"+{h['n_closure_added']} closure, "
-                  f"+{h['n_repaired']} repaired", file=sys.stderr)
+                  f"+{h['n_repaired']} repaired{dropped}", file=sys.stderr)
         state = "converged" if result.converged else \
             f"max_rounds={cfg.max_rounds} reached"
         print(f"{state} after {result.rounds} round(s) in {elapsed:.2f}s",
