@@ -26,7 +26,9 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
 import os
+import time
 from typing import List, NamedTuple, Optional, Tuple
 
 import jax
@@ -37,6 +39,8 @@ from fastconsensus_tpu.graph import GraphSlab, pack_edges
 from fastconsensus_tpu.models.base import Detector
 from fastconsensus_tpu.ops import consensus_ops as cops
 from fastconsensus_tpu.utils import prng
+
+_logger = logging.getLogger("fastconsensus_tpu")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -209,7 +213,7 @@ def consensus_rounds_block(slab: GraphSlab,
 
     def body(carry):
         slab, i, _, buf = carry
-        k = _prng.stream(key, _prng.STREAM_ROUND, start_round + i)
+        k = prng.stream(key, prng.STREAM_ROUND, start_round + i)
         slab, _, st = consensus_round(slab, k, detect=detect, n_p=n_p,
                                       tau=tau, delta=delta,
                                       n_closure=n_closure)
@@ -287,10 +291,6 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
     redetecting them.  Results are identical either way — chunk keys are
     position-derived.
     """
-    import logging
-    import time as _time
-
-    logger = logging.getLogger("fastconsensus_tpu")
     n_p = keys.shape[0]
     jd = _jitted_detect(detect)
     if members >= n_p:
@@ -318,14 +318,14 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
                         f"{cached.shape}, expected "
                         f"{(members, slab.n_nodes)}; clean the cache dir")
                 parts.append(jnp.asarray(cached))
-                logger.debug("detect call %d/%d: loaded from %s",
-                             i + 1, n_calls, path)
+                _logger.debug("detect call %d/%d: loaded from %s",
+                              i + 1, n_calls, path)
                 continue
-        t0 = _time.perf_counter()
+        t0 = time.perf_counter()
         out = jd(slab, keys[i * members:(i + 1) * members])
         out.block_until_ready()
-        logger.debug("detect call %d/%d (%d members): %.1fs",
-                     i + 1, n_calls, members, _time.perf_counter() - t0)
+        _logger.debug("detect call %d/%d (%d members): %.1fs",
+                      i + 1, n_calls, members, time.perf_counter() - t0)
         if path is not None:
             tmp = path + ".tmp"
             with open(tmp, "wb") as fh:  # np.save would append .npy to tmp
@@ -377,6 +377,7 @@ def run_consensus(slab: GraphSlab,
     if key is None:
         key = jax.random.key(config.seed)
     n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
+    members = _members_per_call(slab, config.n_p)
 
     cache_fp = ""
     if detect_cache_dir:
@@ -392,7 +393,7 @@ def run_consensus(slab: GraphSlab,
         cache_fp = hashlib.sha1(repr(
             (config.algorithm, config.n_p, config.tau, config.delta,
              config.seed, config.max_rounds, slab.n_nodes, slab.capacity,
-             _members_per_call(slab, config.n_p))
+             members)
         ).encode()).hexdigest()[:10]
 
     start_round = 0
@@ -444,7 +445,8 @@ def run_consensus(slab: GraphSlab,
                 f"ensemble unsharded. Round n_p up with parallel.pad_n_p.",
                 stacklevel=2)
 
-    members = _members_per_call(slab, config.n_p)
+    # `members` was sized on the pre-shard slab; shard_slab only pads
+    # capacity by < mesh_edge_axis entries, so the estimate carries over
     split_phase = ensemble_sharding is None and members < config.n_p
     # Fused-rounds mode: when a whole round is cheap (small graphs, no
     # sharded mesh, no per-round checkpointing), run blocks of rounds in a

@@ -142,7 +142,7 @@ def hash_buckets_for(n_entries: int, cap: int = 1 << 26) -> int:
     while b < 4 * max(1, n_entries):
         b <<= 1
     if b > cap:
-        import logging
+        import logging  # local: this module is imported on cold paths
 
         logging.getLogger("fastconsensus_tpu").warning(
             "hash table capped at %d buckets for %d entries (load factor "
