@@ -199,8 +199,6 @@ def consensus_rounds_block(slab: GraphSlab,
     (key, start_round + i) exactly as the one-round driver derives them, so
     block size never changes results.
     """
-    from fastconsensus_tpu.utils import prng as _prng
-
     def empty_stats():
         z = jnp.zeros((block,), jnp.int32)
         return RoundStats(converged=jnp.zeros((block,), bool), n_alive=z,
@@ -377,24 +375,6 @@ def run_consensus(slab: GraphSlab,
     if key is None:
         key = jax.random.key(config.seed)
     n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
-    members = _members_per_call(slab, config.n_p)
-
-    cache_fp = ""
-    if detect_cache_dir:
-        import hashlib
-
-        os.makedirs(detect_cache_dir, exist_ok=True)
-        # members is part of the fingerprint: a retry with a different
-        # chunking (the natural response to tunnel trouble) must not load
-        # mis-sized chunks; max_rounds guards the `_final` tag (a capped
-        # run's final detection is of a different consensus graph).
-        # Detector hyper-parameters (e.g. gamma) are NOT captured — use a
-        # fresh cache dir when varying them (documented above).
-        cache_fp = hashlib.sha1(repr(
-            (config.algorithm, config.n_p, config.tau, config.delta,
-             config.seed, config.max_rounds, slab.n_nodes, slab.capacity,
-             members)
-        ).encode()).hexdigest()[:10]
 
     start_round = 0
     prior_history: List[dict] = []
@@ -428,6 +408,28 @@ def run_consensus(slab: GraphSlab,
         # weights <- 1.0 at loop start (fc:135-136); input weights are
         # ignored, matching the reference (documented in utils/io.py).
         slab = slab.with_weights(jnp.where(slab.alive, 1.0, 0.0))
+
+    # Sized AFTER checkpoint resume: the loaded slab's d_cap can differ
+    # from the caller's repack (the resume check matches n_nodes/capacity
+    # only), and d_cap drives the move-path/time estimate.
+    members = _members_per_call(slab, config.n_p)
+
+    cache_fp = ""
+    if detect_cache_dir:
+        import hashlib
+
+        os.makedirs(detect_cache_dir, exist_ok=True)
+        # members is part of the fingerprint: a retry with a different
+        # chunking (the natural response to tunnel trouble) must not load
+        # mis-sized chunks; max_rounds guards the `_final` tag (a capped
+        # run's final detection is of a different consensus graph).
+        # Detector hyper-parameters (e.g. gamma) are NOT captured — use a
+        # fresh cache dir when varying them (documented above).
+        cache_fp = hashlib.sha1(repr(
+            (config.algorithm, config.n_p, config.tau, config.delta,
+             config.seed, config.max_rounds, slab.n_nodes, slab.capacity,
+             members)
+        ).encode()).hexdigest()[:10]
 
     ensemble_sharding = None
     if mesh is not None:
