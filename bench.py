@@ -53,10 +53,16 @@ CONFIGS = {
                    delta=0.02, alg="leiden", max_rounds=12),
     # eval config 4 stand-in: SNAP email-Eu-core cannot be downloaded in
     # this environment (zero egress), so an SBM with its published shape
-    # (1005 nodes, ~25k edges, 42 departments, heavy inter-department mix)
-    # stands in; documented in BASELINE.md
+    # (1005 nodes, ~24k edges, 42 departments with heterogeneous sizes
+    # mimicking the real department histogram) stands in.  Round-1's
+    # equal-size p_out=0.035 variant sat above LPA's detectability
+    # threshold (NMI 0.0 on BOTH sides — no quality signal, VERDICT #5);
+    # the size-skewed mix keeps the published density AND leaves LPA
+    # partial-but-nonzero structure (NMI ~0.3 each side), so the quality
+    # comparison can actually detect a regression.
     "emailEu": dict(kind="planted", n=1005, n_comm=42, p_in=0.6,
-                    p_out=0.035, n_p=50, tau=0.8, delta=0.02, alg="lpm"),
+                    p_out=0.02, size_alpha=0.85, n_p=50, tau=0.8,
+                    delta=0.02, alg="lpm"),
     # eval config 5 analog (stress; SBM sampler, LFR generation at 100k is
     # too slow to run inside the bench)
     "planted100k": dict(kind="planted", n=100_000, n_comm=200, p_in=0.04,
@@ -82,8 +88,17 @@ def make_graph(cfg, seed=42):
         return edges, np.array(KARATE_FACTIONS)
     if cfg["kind"] == "lfr":
         return synth.lfr_graph(cfg["n"], cfg["mu"], seed=seed)
+    sizes = None
+    if cfg.get("size_alpha"):
+        # heterogeneous block sizes ~ rank^-alpha (email-Eu-core-like)
+        w = np.arange(1, cfg["n_comm"] + 1, dtype=float) ** -cfg["size_alpha"]
+        sizes = np.maximum((w / w.sum() * cfg["n"]).astype(np.int64), 2)
+        while sizes.sum() > cfg["n"]:
+            sizes[np.argmax(sizes)] -= 1
+        while sizes.sum() < cfg["n"]:
+            sizes[np.argmin(sizes)] += 1
     return synth.planted_partition(cfg["n"], cfg["n_comm"], cfg["p_in"],
-                                   cfg["p_out"], seed=seed)
+                                   cfg["p_out"], seed=seed, sizes=sizes)
 
 
 def measure_baseline(name, cfg, edges, n_nodes, truth):
@@ -140,6 +155,19 @@ def main() -> int:
     from fastconsensus_tpu.utils.metrics import nmi
 
     n_chips = jax.local_device_count()
+    # Multi-chip: shard the ensemble axis over every local device (the DP
+    # analog; parallel/sharding.py).  On the single-chip driver bench this
+    # is a no-op; on a real v5e-8 (or the 8-device virtual CPU mesh) the
+    # same code path measures sharded throughput with zero new code
+    # (VERDICT round 1 #6).  The ensemble axis takes the largest divisor of
+    # n_p <= device count so member counts stay exact.
+    mesh = None
+    if n_chips > 1:
+        from fastconsensus_tpu import parallel
+
+        ens = max(d for d in range(1, n_chips + 1) if cfg["n_p"] % d == 0)
+        mesh = parallel.make_mesh(ensemble=ens, edge=1,
+                                  devices=jax.devices()[:ens])
     slab = pack_edges(edges, n_nodes)
     detector = get_detector(cfg["alg"])
     ccfg = ConsensusConfig(algorithm=cfg["alg"], n_p=cfg["n_p"],
@@ -159,14 +187,17 @@ def main() -> int:
 
     # Warmup: pays all jit compiles (round step + final detection).
     warm = run_consensus(slab, detector, ccfg, key=jax.random.key(123),
-                         on_round=on_round)
+                         mesh=mesh, on_round=on_round)
     # Timed run, fresh seed, same (cached) executables.
     t0 = time.perf_counter()
     result = run_consensus(slab, detector, ccfg, key=jax.random.key(0),
-                           on_round=on_round)
+                           mesh=mesh, on_round=on_round)
     elapsed = time.perf_counter() - t0
 
-    value = ccfg.n_p / elapsed / max(n_chips, 1)
+    # normalize by the chips the mesh actually uses (3 of 8 idle when n_p
+    # has no divisor reaching the device count — they do no work)
+    chips_used = mesh.size if mesh is not None else max(n_chips, 1)
+    value = ccfg.n_p / elapsed / chips_used
     quality = float(nmi(result.partitions[0], truth))
     out = {
         "metric": "consensus_partitions_per_sec_per_chip",
@@ -180,6 +211,8 @@ def main() -> int:
         "rounds": result.rounds,
         "converged": bool(result.converged),
         "n_chips": n_chips,
+        "mesh": (f"{mesh.shape['p']}x{mesh.shape['e']}"
+                 if mesh is not None else "1x1"),
         "backend": jax.default_backend(),
         "warmup_rounds": warm.rounds,
     }

@@ -57,12 +57,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-rounds", type=int, default=64,
                    help="safety cap on consensus rounds (default: 64)")
     p.add_argument("--capacity", type=int, default=None, metavar="E_CAP",
-                   help="edge-slab capacity (default: 2*E+16). Size up for "
-                        "dense consensus graphs where triadic closure "
-                        "saturates the slab (watch the per-round 'dropped' "
-                        "count). Changing it invalidates an existing "
-                        "--checkpoint (capacity is part of the compiled "
-                        "shapes): restart the run fresh")
+                   help="initial edge-slab capacity (default: 2*E+16). The "
+                        "slab self-sizes: a saturated round grows it and "
+                        "replays (one recompile); pre-sizing here skips "
+                        "those recompiles on dense consensus graphs. On "
+                        "--resume the checkpoint's (possibly auto-grown) "
+                        "capacity wins unless this asks for more")
+    p.add_argument("--no-grow", action="store_true",
+                   help="disable slab self-sizing; saturated rounds drop "
+                        "closure candidates with a reported count (the "
+                        "round-1 behavior)")
+    p.add_argument("--cold-detect", action="store_true",
+                   help="disable warm-started detection (every round "
+                        "re-derives partitions from singletons, like the "
+                        "reference); warm start is the default and is "
+                        "usually several times faster at equal quality")
     p.add_argument("--out-dir", type=str, default=".",
                    help="directory to create output trees in (default: .)")
     p.add_argument("--quiet", action="store_true",
@@ -124,6 +133,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"warning: -g {args.gamma} ignored for --alg {args.alg} "
                   f"(resolution applies to modularity detectors)",
                   file=sys.stderr)
+            # an ignored gamma must not poison checkpoint/detect-cache
+            # fingerprints either — results are provably identical
+            args.gamma = 1.0
         detector = get_detector(args.alg, gamma=args.gamma)
     except (ValueError, NotImplementedError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -137,7 +149,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     cfg = ConsensusConfig(algorithm=args.alg, n_p=args.n_p, tau=args.tau,
                           delta=args.delta, max_rounds=args.max_rounds,
-                          seed=args.seed)
+                          seed=args.seed, gamma=args.gamma,
+                          auto_grow=not args.no_grow,
+                          warm_start=not args.cold_detect)
     from fastconsensus_tpu.utils.trace import RoundTracer, profiler_trace
 
     tracer = RoundTracer(jsonl_path=args.trace_jsonl)
@@ -158,8 +172,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if not args.quiet:
         for h in result.history:
-            dropped = (f", {h['n_dropped']} dropped (capacity; see "
-                       f"--capacity)" if h["n_dropped"] else "")
+            dropped = (f", {h['n_dropped']} dropped (capacity; rerun "
+                       f"without --no-grow)" if h["n_dropped"] else "")
             print(f"round {h['round']}: {h['n_alive']} edges, "
                   f"{h['n_unconverged']} unconverged, "
                   f"+{h['n_closure_added']} closure, "

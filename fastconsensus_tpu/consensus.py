@@ -39,6 +39,7 @@ from fastconsensus_tpu.graph import GraphSlab, pack_edges
 from fastconsensus_tpu.models.base import Detector
 from fastconsensus_tpu.ops import consensus_ops as cops
 from fastconsensus_tpu.utils import prng
+from fastconsensus_tpu.utils.env import env_int
 
 _logger = logging.getLogger("fastconsensus_tpu")
 
@@ -53,6 +54,27 @@ class ConsensusConfig:
     delta: float = 0.02       # convergence: frac of edges allowed mid-weight
     max_rounds: int = 64      # safety cap (reference loops unboundedly)
     seed: int = 0
+    # Detector hyper-parameters that change results (currently the
+    # resolution parameter -g).  Part of the config so checkpoint/
+    # detect-cache fingerprints reject mixing runs across values.  Used for
+    # fingerprinting ONLY — it must equal the gamma the detector passed to
+    # run_consensus was built with (the CLI and fast_consensus keep the two
+    # in lockstep; hand-built pairs are the caller's responsibility).
+    gamma: float = 1.0
+    # Self-sizing slab: when a round drops closure/repair survivors for
+    # capacity, grow the slab and deterministically replay that round so
+    # nothing is lost (one recompile per growth).  Off = report-and-continue
+    # (the round 1 behavior; candidates are dropped with a counter).
+    auto_grow: bool = True
+    # Warm-start detection: seed each round's (and the final) detection from
+    # the previous round's labels.  The consensus graph changes little
+    # between rounds, so warm members converge in a few sweeps AND keep
+    # their tie-degenerate choices stable across rounds, cutting both
+    # per-round sweep count and the number of consensus rounds (the
+    # reference re-runs every detection from scratch, fc:148 — its
+    # libraries offer no warm path).  Ignored by detectors that do not
+    # support initialization (native CNM/Infomap).
+    warm_start: bool = True
 
 
 class RoundStats(NamedTuple):
@@ -125,12 +147,20 @@ def consensus_round(slab: GraphSlab,
                     tau: float,
                     delta: float,
                     n_closure: int,
-                    ensemble_sharding=None) -> Tuple[GraphSlab, jax.Array, RoundStats]:
+                    ensemble_sharding=None,
+                    init_labels: Optional[jax.Array] = None
+                    ) -> Tuple[GraphSlab, jax.Array, RoundStats]:
     """One full consensus round.  Jittable; all shapes static.
 
     Returns (next_slab, labels[n_p, N], stats).  ``n_closure`` is L, the
     original edge count (the reference re-reads it from the *input* graph
     every round, fc:144/:175 — so it is static).
+
+    ``init_labels`` ([n_p, N]) warm-starts detection from the previous
+    round's labels — the consensus graph changes little between rounds, so
+    warm members converge in a few sweeps instead of re-deriving the
+    partition from singletons every round (the driver threads this;
+    None = from-scratch, the reference's only mode, fc:148).
 
     ``ensemble_sharding`` (a ``NamedSharding`` with spec ``P("p")``) pins the
     per-partition keys and labels to the mesh's ensemble axis; XLA then runs
@@ -146,8 +176,15 @@ def consensus_round(slab: GraphSlab,
         labels_sharding = NamedSharding(
             ensemble_sharding.mesh,
             PartitionSpec(*ensemble_sharding.spec, None))
-        labels = jax.lax.with_sharding_constraint(
-            detect(slab, keys), labels_sharding)
+        if init_labels is not None:
+            init_labels = jax.lax.with_sharding_constraint(
+                init_labels, labels_sharding)
+            raw = detect(slab, keys, init_labels)
+        else:
+            raw = detect(slab, keys)
+        labels = jax.lax.with_sharding_constraint(raw, labels_sharding)
+    elif init_labels is not None:
+        labels = detect(slab, keys, init_labels)
     else:
         labels = detect(slab, keys)
     slab, stats = consensus_tail(slab, labels, k_closure, n_p, tau, delta,
@@ -177,6 +214,7 @@ def _jitted_detect(detect: Detector):
 
 def consensus_rounds_block(slab: GraphSlab,
                            key: jax.Array,
+                           labels0: jax.Array,
                            start_round: jax.Array,
                            max_iters: jax.Array,
                            detect: Detector,
@@ -184,8 +222,10 @@ def consensus_rounds_block(slab: GraphSlab,
                            tau: float,
                            delta: float,
                            n_closure: int,
-                           block: int
-                           ) -> Tuple[GraphSlab, jax.Array, RoundStats]:
+                           block: int,
+                           warm: bool
+                           ) -> Tuple[GraphSlab, jax.Array, RoundStats,
+                                      jax.Array]:
     """Up to ``min(block, max_iters)`` consensus rounds in ONE device call.
 
     On small graphs a round's device time is a few hundred ms, so the
@@ -194,10 +234,16 @@ def consensus_rounds_block(slab: GraphSlab,
     rounds amortizes it ``block``-fold.  Stops early on delta-convergence.
     ``max_iters`` is traced (the driver's remaining-round budget never
     triggers a recompile).  Returns (slab, n_rounds_done, stacked
-    stats[block]); entries past n_rounds_done are garbage and must be
-    ignored.  ``key`` is the run key: per-round keys are derived from
-    (key, start_round + i) exactly as the one-round driver derives them, so
-    block size never changes results.
+    stats[block], last_labels); stats entries past n_rounds_done are garbage
+    and must be ignored.  ``key`` is the run key: per-round keys are derived
+    from (key, start_round + i) exactly as the one-round driver derives
+    them, so block size never changes results.
+
+    ``labels0`` [n_p, N] seeds the first round's detection when ``warm``
+    (consensus_round init_labels); each later round warm-starts from its
+    predecessor's labels via the loop carry.  With ``warm=False`` the carry
+    still tracks labels (for the caller's next block / final detection) but
+    detection always cold-starts.
     """
     def empty_stats():
         z = jnp.zeros((block,), jnp.int32)
@@ -206,29 +252,31 @@ def consensus_rounds_block(slab: GraphSlab,
                           n_dropped=z, n_overflow=z)
 
     def cond(carry):
-        _, i, conv, _ = carry
+        _, i, conv, _, _ = carry
         return (~conv) & (i < block) & (i < max_iters)
 
     def body(carry):
-        slab, i, _, buf = carry
+        slab, i, _, buf, labels = carry
         k = prng.stream(key, prng.STREAM_ROUND, start_round + i)
-        slab, _, st = consensus_round(slab, k, detect=detect, n_p=n_p,
-                                      tau=tau, delta=delta,
-                                      n_closure=n_closure)
+        slab, labels, st = consensus_round(
+            slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
+            n_closure=n_closure,
+            init_labels=labels if warm else None)
         buf = jax.tree.map(lambda b, s: b.at[i].set(s), buf, st)
-        return slab, i + 1, st.converged, buf
+        return slab, i + 1, st.converged, buf, labels
 
-    slab, done, _, buf = jax.lax.while_loop(
-        cond, body, (slab, jnp.int32(0), jnp.bool_(False), empty_stats()))
-    return slab, done, buf
+    slab, done, _, buf, labels = jax.lax.while_loop(
+        cond, body,
+        (slab, jnp.int32(0), jnp.bool_(False), empty_stats(), labels0))
+    return slab, done, buf, labels
 
 
 @functools.lru_cache(maxsize=128)
 def _jitted_rounds_block(detect: Detector, n_p: int, tau: float, delta: float,
-                         n_closure: int, block: int):
+                         n_closure: int, block: int, warm: bool):
     return jax.jit(functools.partial(
         consensus_rounds_block, detect=detect, n_p=n_p, tau=tau, delta=delta,
-        n_closure=n_closure, block=block))
+        n_closure=n_closure, block=block, warm=warm))
 
 
 @functools.lru_cache(maxsize=128)
@@ -249,9 +297,8 @@ def _members_per_call(slab: GraphSlab, n_p: int) -> int:
     call for safety margin; FCTPU_DETECT_CALL_MEMBERS overrides (<= 0
     disables splitting).
     """
-    env = os.environ.get("FCTPU_DETECT_CALL_MEMBERS", "")
-    if env:
-        c = int(env)
+    c = env_int("FCTPU_DETECT_CALL_MEMBERS")
+    if c is not None:
         return n_p if c <= 0 else min(c, n_p)
     return max(1, min(n_p, int(15.0 / max(_est_member_seconds(slab), 1e-9))))
 
@@ -259,9 +306,11 @@ def _members_per_call(slab: GraphSlab, n_p: int) -> int:
 # Measured effective cost per byte of per-sweep temporaries, by move path
 # (TPU v5e via the dev tunnel): the matmul path streams (MXU/HBM-bound),
 # dense pays the row sort / pallas compare, hash and runs are
-# scatter/sort-bound.  Calibrated against lfr1k (matmul), planted-100k
-# (dense) and lfr10k (hash) detections.
-_NS_PER_TEMP_BYTE = {"matmul": 0.02, "dense": 0.2, "hash": 0.8, "runs": 1.5}
+# scatter/sort-bound; hybrid sits between dense and hash (narrow rows +
+# small scatters).  Calibrated against lfr1k (matmul), planted-100k
+# (dense) and lfr10k (hash/hybrid) detections.
+_NS_PER_TEMP_BYTE = {"matmul": 0.02, "dense": 0.2, "hybrid": 0.3,
+                     "hash": 0.8, "runs": 1.5}
 
 
 def _est_member_seconds(slab: GraphSlab) -> float:
@@ -276,7 +325,8 @@ def _est_member_seconds(slab: GraphSlab) -> float:
 def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
                     members: int,
                     cache_dir: Optional[str] = None,
-                    cache_tag: str = "") -> jax.Array:
+                    cache_tag: str = "",
+                    init_labels: Optional[jax.Array] = None) -> jax.Array:
     """Run detection as ceil(n_p / members) separate device calls.
 
     Labels stay on device; only the dispatches are split.  Chunks reuse one
@@ -287,12 +337,20 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
     restarted run (the TPU tunnel wedges multi-hundred-call sequences, see
     utils/trace.py notes) skips straight past finished chunks instead of
     redetecting them.  Results are identical either way — chunk keys are
-    position-derived.
+    position-derived — *provided the detector is per-key independent*
+    (member i's labels depend only on (slab, keys[i])).  Every ensemble()
+    lift satisfies this; a custom Detector that mixes information across
+    the keys axis would silently change results under chunking (see the
+    Detector protocol docstring).
     """
     n_p = keys.shape[0]
     jd = _jitted_detect(detect)
+
+    def call(ks, init):
+        return jd(slab, ks) if init is None else jd(slab, ks, init)
+
     if members >= n_p:
-        return jd(slab, keys)
+        return call(keys, init_labels)
     # Pad to a whole number of equal chunks: one compiled shape for every
     # call (a ragged remainder would pay a second multi-minute remote
     # compile for at most `members-1` members of work).
@@ -303,6 +361,8 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
         idx = jnp.concatenate([jnp.arange(n_p, dtype=jnp.int32),
                                jnp.full((pad,), n_p - 1, jnp.int32)])
         keys = keys[idx]
+        if init_labels is not None:
+            init_labels = init_labels[idx]
     parts = []
     for i in range(n_calls):
         path = None
@@ -310,17 +370,21 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
             path = os.path.join(cache_dir, f"{cache_tag}_c{i}.npy")
             if os.path.exists(path):
                 cached = np.load(path)
-                if cached.shape != (members, slab.n_nodes):
+                if cached.shape != (members, slab.n_nodes) or \
+                        cached.dtype != np.int32:
                     raise ValueError(
                         f"stale detect-chunk cache {path}: shape "
-                        f"{cached.shape}, expected "
-                        f"{(members, slab.n_nodes)}; clean the cache dir")
+                        f"{cached.shape} dtype {cached.dtype}, expected "
+                        f"{(members, slab.n_nodes)} int32; clean the "
+                        f"cache dir")
                 parts.append(jnp.asarray(cached))
                 _logger.debug("detect call %d/%d: loaded from %s",
                               i + 1, n_calls, path)
                 continue
         t0 = time.perf_counter()
-        out = jd(slab, keys[i * members:(i + 1) * members])
+        sl = slice(i * members, (i + 1) * members)
+        out = call(keys[sl],
+                   None if init_labels is None else init_labels[sl])
         out.block_until_ready()
         _logger.debug("detect call %d/%d (%d members): %.1fs",
                       i + 1, n_calls, members, time.perf_counter() - t0)
@@ -375,6 +439,11 @@ def run_consensus(slab: GraphSlab,
     if key is None:
         key = jax.random.key(config.seed)
     n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
+    warm = config.warm_start and getattr(detect, "supports_init", False)
+    # Last successful round's labels [n_p, N] (device-resident); None until
+    # the first round completes.  Seeds warm detection and the final
+    # re-detection; persisted in checkpoints so resume stays bit-identical.
+    cur_labels: Optional[jax.Array] = None
 
     start_round = 0
     prior_history: List[dict] = []
@@ -386,19 +455,35 @@ def run_consensus(slab: GraphSlab,
         in_nodes, in_cap = slab.n_nodes, slab.capacity
         slab, start_round, key_data, prior_history, extra = \
             ckpt.load_checkpoint(checkpoint_path)
+        if warm and extra.get("_labels") is not None:
+            cur_labels = jnp.asarray(extra["_labels"])
         key = jax.random.wrap_key_data(jnp.asarray(key_data))
         # Reject checkpoints from a different run configuration: resuming a
         # tau/n_p/algorithm/graph mismatch would silently mix semantics
         # (weights are co-membership counts out of the *saved* n_p).
         saved = {k: extra.get(k) for k in
-                 ("algorithm", "n_p", "tau", "delta")}
+                 ("algorithm", "n_p", "tau", "delta", "gamma",
+                  "warm_start")}
         want = {"algorithm": config.algorithm, "n_p": config.n_p,
-                "tau": config.tau, "delta": config.delta}
+                "tau": config.tau, "delta": config.delta,
+                "gamma": config.gamma, "warm_start": config.warm_start}
         mismatch = {k: (saved[k], want[k]) for k in want
                     if saved[k] is not None and saved[k] != want[k]}
-        if slab.n_nodes != in_nodes or slab.capacity != in_cap:
-            mismatch["graph"] = ((slab.n_nodes, slab.capacity),
-                                 (in_nodes, in_cap))
+        if slab.n_nodes != in_nodes:
+            mismatch["graph"] = (slab.n_nodes, in_nodes)
+        elif slab.capacity < in_cap:
+            # The caller asked for more room than the checkpoint has
+            # (e.g. --capacity raised after watching growth recompiles):
+            # honor it — growth is result-preserving (graph.grow_slab).
+            from fastconsensus_tpu.graph import grow_slab
+
+            _logger.info("growing resumed slab capacity %d -> %d to honor "
+                         "the requested pack size", slab.capacity, in_cap)
+            slab = grow_slab(slab, in_cap)
+        elif slab.capacity > in_cap:
+            # Legitimate trace of mid-run auto-growth; keep it.
+            _logger.info("resuming with auto-grown slab capacity %d "
+                         "(freshly packed: %d)", slab.capacity, in_cap)
         if mismatch:
             raise ValueError(
                 f"checkpoint {checkpoint_path} was written by a different "
@@ -408,28 +493,6 @@ def run_consensus(slab: GraphSlab,
         # weights <- 1.0 at loop start (fc:135-136); input weights are
         # ignored, matching the reference (documented in utils/io.py).
         slab = slab.with_weights(jnp.where(slab.alive, 1.0, 0.0))
-
-    # Sized AFTER checkpoint resume: the loaded slab's d_cap can differ
-    # from the caller's repack (the resume check matches n_nodes/capacity
-    # only), and d_cap drives the move-path/time estimate.
-    members = _members_per_call(slab, config.n_p)
-
-    cache_fp = ""
-    if detect_cache_dir:
-        import hashlib
-
-        os.makedirs(detect_cache_dir, exist_ok=True)
-        # members is part of the fingerprint: a retry with a different
-        # chunking (the natural response to tunnel trouble) must not load
-        # mis-sized chunks; max_rounds guards the `_final` tag (a capped
-        # run's final detection is of a different consensus graph).
-        # Detector hyper-parameters (e.g. gamma) are NOT captured — use a
-        # fresh cache dir when varying them (documented above).
-        cache_fp = hashlib.sha1(repr(
-            (config.algorithm, config.n_p, config.tau, config.delta,
-             config.seed, config.max_rounds, slab.n_nodes, slab.capacity,
-             members)
-        ).encode()).hexdigest()[:10]
 
     ensemble_sharding = None
     if mesh is not None:
@@ -447,28 +510,91 @@ def run_consensus(slab: GraphSlab,
                 f"ensemble unsharded. Round n_p up with parallel.pad_n_p.",
                 stacklevel=2)
 
-    # `members` was sized on the pre-shard slab; shard_slab only pads
-    # capacity by < mesh_edge_axis entries, so the estimate carries over
-    split_phase = ensemble_sharding is None and members < config.n_p
-    # Fused-rounds mode: when a whole round is cheap (small graphs, no
-    # sharded mesh, no per-round checkpointing), run blocks of rounds in a
-    # single device call — the per-round dispatch + stats-readback latency
-    # through the TPU tunnel otherwise dominates the driver loop.  Block
-    # size targets ~15 s per call; 1 disables fusion.
-    est_round_s = _est_member_seconds(slab) * config.n_p
+    members = 0
+    cache_fp = ""
+    split_phase = False
     fused_block = 1
-    if not split_phase and checkpoint_path is None and mesh is None:
-        fused_block = max(1, min(8, int(15.0 / max(est_round_s, 1e-9))))
-    if fused_block > 1:
-        block_fn = _jitted_rounds_block(
-            detect, config.n_p, config.tau, config.delta, n_closure,
-            fused_block)
-    elif not split_phase:
-        round_fn = _jitted_round(detect, config.n_p, config.tau, config.delta,
-                                 n_closure, ensemble_sharding)
-    else:
-        tail_fn = _jitted_tail(config.n_p, config.tau, config.delta,
-                               n_closure)
+    block_fn = round_fn = tail_fn = None
+
+    def setup_executables() -> None:
+        """(Re-)derive call sizing and jitted step functions from the
+        current slab.  Rerun after auto-growth — capacity is part of the
+        compiled shapes, so growth costs one recompile here."""
+        nonlocal members, cache_fp, split_phase, fused_block
+        nonlocal block_fn, round_fn, tail_fn
+        # Sized AFTER checkpoint resume: the loaded slab's d_cap can differ
+        # from the caller's repack (the resume check matches
+        # n_nodes/capacity only), and d_cap drives the move-path/time
+        # estimate.  shard_slab only pads capacity by < mesh_edge_axis
+        # entries, so the estimate carries over to the sharded slab.
+        members = _members_per_call(slab, config.n_p)
+        cache_fp = ""
+        if detect_cache_dir:
+            import hashlib
+
+            os.makedirs(detect_cache_dir, exist_ok=True)
+            # members is part of the fingerprint: a retry with a different
+            # chunking (the natural response to tunnel trouble) must not
+            # load mis-sized chunks; max_rounds guards the `_final` tag (a
+            # capped run's final detection is of a different consensus
+            # graph); gamma (detector hyper-parameter) guards rerunning
+            # with a different -g against the same dir — shape checks
+            # cannot catch that.  Live capacity is deliberately absent:
+            # labels are capacity-independent (louvain._cap_hint), so
+            # auto-growth must not retire a round's already-detected
+            # chunks; cap_hint covers the pack-time sizing instead.
+            cache_fp = hashlib.sha1(repr(
+                (config.algorithm, config.n_p, config.tau, config.delta,
+                 config.seed, config.max_rounds, slab.n_nodes,
+                 slab.cap_hint or slab.capacity, members, config.gamma,
+                 warm)
+            ).encode()).hexdigest()[:10]
+        split_phase = ensemble_sharding is None and members < config.n_p
+        # Fused-rounds mode: when a whole round is cheap (small graphs, no
+        # sharded mesh, no per-round checkpointing), run blocks of rounds
+        # in a single device call — the per-round dispatch + stats-readback
+        # latency through the TPU tunnel otherwise dominates the driver
+        # loop.  Block size targets ~15 s per call; 1 disables fusion.
+        est_round_s = _est_member_seconds(slab) * config.n_p
+        fused_block = 1
+        if not split_phase and checkpoint_path is None and mesh is None:
+            fused_block = max(1, min(8, int(15.0 / max(est_round_s, 1e-9))))
+        block_fn = round_fn = tail_fn = None
+        if fused_block > 1:
+            block_fn = _jitted_rounds_block(
+                detect, config.n_p, config.tau, config.delta, n_closure,
+                fused_block, warm)
+        elif not split_phase:
+            round_fn = _jitted_round(detect, config.n_p, config.tau,
+                                     config.delta, n_closure,
+                                     ensemble_sharding)
+        else:
+            tail_fn = _jitted_tail(config.n_p, config.tau, config.delta,
+                                   n_closure)
+
+    setup_executables()
+
+    def grow_and_replay(pre_slab: GraphSlab, dropped: int) -> None:
+        """Self-sizing slab: grow from the *pre-round* state and let the
+        caller replay the round.  Replay is deterministic (same round key,
+        growth preserves slot-fill order — graph.grow_slab), so the replayed
+        round reproduces itself exactly except the previously dropped
+        survivors now land in the new tail slots."""
+        nonlocal slab
+        from fastconsensus_tpu.graph import grow_slab
+
+        new_cap = pre_slab.capacity + max(2 * dropped,
+                                          pre_slab.capacity // 2)
+        _logger.warning(
+            "edge slab saturated (%d survivors dropped); growing capacity "
+            "%d -> %d and replaying the round", dropped, pre_slab.capacity,
+            new_cap)
+        slab = grow_slab(pre_slab, new_cap)
+        if mesh is not None:
+            from fastconsensus_tpu.parallel import sharding as shard
+
+            slab = shard.shard_slab(slab, mesh)
+        setup_executables()
 
     def record(stats) -> bool:
         """Append one round's (host-side) stats; returns converged."""
@@ -482,6 +608,7 @@ def run_consensus(slab: GraphSlab,
             "n_repaired": int(stats.n_repaired),
             "n_dropped": int(stats.n_dropped),
             "n_overflow": int(stats.n_overflow),
+            "capacity": slab.capacity,
         }
         history.append(entry)
         if on_round is not None:
@@ -493,13 +620,32 @@ def run_consensus(slab: GraphSlab,
     converged = resumed_converged
     rounds = start_round
     end_round = start_round if resumed_converged else config.max_rounds
+    if warm and cur_labels is None:
+        # Round-0 warm init = singletons, which is exactly what every
+        # kernel's cold start uses — so warm mode needs only one trace and
+        # round 0 is bit-identical to a cold run.
+        cur_labels = jnp.broadcast_to(
+            jnp.arange(slab.n_nodes, dtype=jnp.int32),
+            (config.n_p, slab.n_nodes))
     r = start_round
     while r < end_round:
+        pre_slab = slab
         if fused_block > 1:
-            slab, done, buf = block_fn(slab, key, jnp.int32(r),
-                                       jnp.int32(end_round - r))
+            labels0 = cur_labels if warm else jnp.zeros(
+                (config.n_p, slab.n_nodes), jnp.int32)
+            slab, done, buf, new_labels = block_fn(
+                slab, key, labels0, jnp.int32(r), jnp.int32(end_round - r))
             done = int(done)
             buf = jax.device_get(buf)
+            dropped = int(max((buf.n_dropped[i] for i in range(done)),
+                              default=0))
+            if config.auto_grow and dropped > 0:
+                # the block replays from its start; rounds before the
+                # saturating one recompute identically (same keys)
+                grow_and_replay(pre_slab, dropped)
+                continue
+            if warm:
+                cur_labels = new_labels
             for i in range(done):
                 if record(jax.tree.map(lambda b: b[i], buf)):
                     break
@@ -513,18 +659,44 @@ def run_consensus(slab: GraphSlab,
                 # one-call execution produce identical results
                 k_detect, k_closure = jax.random.split(k)
                 keys = prng.partition_keys(k_detect, config.n_p)
-                labels = _detect_chunked(detect, slab, keys, members,
-                                         cache_dir=detect_cache_dir,
-                                         cache_tag=f"{cache_fp}_r{r}")
+                labels = _detect_chunked(
+                    detect, slab, keys, members,
+                    cache_dir=detect_cache_dir,
+                    cache_tag=f"{cache_fp}_r{r}",
+                    init_labels=cur_labels if warm else None)
                 slab, stats = tail_fn(slab, labels, k_closure)
+                stats = jax.device_get(stats)
+                while config.auto_grow and int(stats.n_dropped) > 0:
+                    # capacity only matters after detection: replay just
+                    # the tail with the in-hand labels (labels are
+                    # capacity-independent; redetecting here would double
+                    # the round's dominant cost at exactly the scale
+                    # split-phase exists for)
+                    grow_and_replay(pre_slab, int(stats.n_dropped))
+                    slab, stats = _jitted_tail(
+                        config.n_p, config.tau, config.delta, n_closure)(
+                        slab, labels, k_closure)
+                    stats = jax.device_get(stats)
+                if warm:
+                    cur_labels = labels
             else:
-                slab, _, stats = round_fn(slab, k)
+                if warm:
+                    slab_new, new_labels, stats = round_fn(
+                        slab, k, init_labels=cur_labels)
+                else:
+                    slab_new, new_labels, stats = round_fn(slab, k)
+                slab = slab_new
+                # One bulk device->host transfer for the whole stats tuple:
+                # per-field scalar readbacks each pay the full device
+                # round-trip latency, which through the TPU tunnel dwarfs
+                # the round's compute (measured).
+                stats = jax.device_get(stats)
+                if config.auto_grow and int(stats.n_dropped) > 0:
+                    grow_and_replay(pre_slab, int(stats.n_dropped))
+                    continue
+                if warm:
+                    cur_labels = new_labels
             r += 1
-            # One bulk device->host transfer for the whole stats tuple:
-            # per-field scalar readbacks each pay the full device
-            # round-trip latency, which through the TPU tunnel dwarfs the
-            # round's compute (measured).
-            stats = jax.device_get(stats)
             record(stats)
             if checkpoint_path is not None and \
                     (rounds % checkpoint_every == 0 or converged):
@@ -535,21 +707,34 @@ def run_consensus(slab: GraphSlab,
                     np.asarray(jax.random.key_data(key)), history,
                     extra={"algorithm": config.algorithm, "n_p": config.n_p,
                            "tau": config.tau, "delta": config.delta,
-                           "converged": converged})
+                           "gamma": config.gamma,
+                           "warm_start": config.warm_start,
+                           "converged": converged},
+                    labels=(np.asarray(cur_labels) if warm else None))
             if converged:
                 break
 
     final_keys = prng.partition_keys(
         prng.stream(key, prng.STREAM_FINAL), config.n_p)
+    # Warm-start the final re-detection too: on a converged consensus graph
+    # the structure is stark, so warm members exit after a sweep or two
+    # (measured round 1: even on a fully converged graph, cold detection
+    # still cost 73% of fresh-graph time — the churn floor, BASELINE.md).
     if mesh is not None and ensemble_sharding is not None:
         from fastconsensus_tpu.parallel import sharding as shard
 
         final_keys = shard.shard_keys(final_keys, mesh)
-        final_labels = _jitted_detect(detect)(slab, final_keys)
+        if warm:
+            final_labels = _jitted_detect(detect)(slab, final_keys,
+                                                  cur_labels)
+        else:
+            final_labels = _jitted_detect(detect)(slab, final_keys)
     else:
         final_labels = _detect_chunked(detect, slab, final_keys, members,
                                        cache_dir=detect_cache_dir,
-                                       cache_tag=f"{cache_fp}_final")
+                                       cache_tag=f"{cache_fp}_final",
+                                       init_labels=cur_labels if warm
+                                       else None)
     # Single bulk readback of the [n_p, N] label matrix (per-row transfers
     # each pay the device round-trip; see the stats readback note above).
     all_labels = jax.device_get(final_labels)
@@ -565,12 +750,22 @@ def fast_consensus(edges: np.ndarray,
                    tau: float = 0.2,
                    delta: float = 0.02,
                    seed: int = 0,
-                   max_rounds: int = 64) -> ConsensusResult:
+                   max_rounds: int = 64,
+                   gamma: float = 1.0) -> ConsensusResult:
     """Convenience API mirroring the reference's ``fast_consensus()``
-    signature (fc:129) with edges in, partitions out."""
-    from fastconsensus_tpu.models.registry import get_detector
+    signature (fc:129) with edges in, partitions out.  ``gamma`` reaches
+    both the detector and the config fingerprints, so it cannot drift the
+    way a hand-built (detector, config) pair can (see ConsensusConfig)."""
+    from fastconsensus_tpu.models.registry import get_detector, supports_param
 
     slab = pack_edges(edges, n_nodes)
+    if gamma != 1.0 and not supports_param(algorithm, "gamma"):
+        import warnings
+
+        warnings.warn(
+            f"gamma={gamma} ignored for algorithm={algorithm!r} (resolution "
+            f"applies to modularity detectors)", stacklevel=2)
+        gamma = 1.0
     cfg = ConsensusConfig(algorithm=algorithm, n_p=n_p, tau=tau, delta=delta,
-                          seed=seed, max_rounds=max_rounds)
-    return run_consensus(slab, get_detector(algorithm), cfg)
+                          seed=seed, max_rounds=max_rounds, gamma=gamma)
+    return run_consensus(slab, get_detector(algorithm, gamma=gamma), cfg)

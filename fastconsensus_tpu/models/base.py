@@ -15,15 +15,26 @@ community (co-membership is an equality test, ops/consensus_ops.py).
 
 from __future__ import annotations
 
-import os
 from typing import Callable, Protocol
 
 import jax
 
 from fastconsensus_tpu.graph import GraphSlab
+from fastconsensus_tpu.utils.env import env_int
 
 
 class Detector(Protocol):
+    """``detect(slab, keys[n_p, ...]) -> labels int32[n_p, N]``.
+
+    Implementations must be **per-key independent**: member i's labels may
+    depend only on ``(slab, keys[i])``, never on other rows of ``keys``.
+    The consensus driver relies on this to split detection into chunked
+    device calls and to shard the ensemble axis over a mesh — a detector
+    mixing information across the keys axis would give different results
+    under different chunkings/shardings.  Every :func:`ensemble` lift
+    satisfies the requirement by construction.
+    """
+
     def __call__(self, slab: GraphSlab, keys: jax.Array) -> jax.Array: ...
 
 
@@ -50,11 +61,10 @@ def ensemble_chunk(slab: GraphSlab, n_p: int) -> int:
     FCTPU_ENSEMBLE_CHUNK (<=0 disables chunking, e.g. on multi-chip meshes
     where the ensemble axis is already sharded across devices).
     """
-    env = os.environ.get("FCTPU_ENSEMBLE_CHUNK", "")
-    if env:
-        c = int(env)
+    c = env_int("FCTPU_ENSEMBLE_CHUNK")
+    if c is not None:
         return n_p if c <= 0 else min(c, n_p)
-    budget = int(os.environ.get("FCTPU_ENSEMBLE_BUDGET_MB", "2048")) << 20
+    budget = env_int("FCTPU_ENSEMBLE_BUDGET_MB", 2048) << 20
     return max(1, min(n_p, budget // max(1, _sweep_bytes_per_member(slab))))
 
 
@@ -65,14 +75,32 @@ def ensemble(single: Callable[[GraphSlab, jax.Array], jax.Array]) -> Detector:
     otherwise ``lax.map(..., batch_size=chunk)`` — sequential chunks of a
     vmapped inner kernel, bounding peak HBM at chunk * per-member bytes
     while keeping each chunk wide enough to saturate the chip.
-    """
 
-    def detect(slab: GraphSlab, keys: jax.Array) -> jax.Array:
+    If ``single`` accepts an ``init_labels`` keyword, the lifted detector
+    exposes warm-starting: ``detect(slab, keys, init_labels=[n_p, N])``
+    seeds member i's optimization from ``init_labels[i]`` (the consensus
+    driver passes the previous round's labels — the reference re-runs each
+    round's detections from scratch, fast_consensus.py:148, because its
+    libraries offer no warm path).  The lifted function advertises this via
+    ``detect.supports_init``.
+    """
+    import inspect
+
+    has_init = "init_labels" in inspect.signature(single).parameters
+
+    def detect(slab: GraphSlab, keys: jax.Array,
+               init_labels: jax.Array = None) -> jax.Array:
         n_p = keys.shape[0]
         chunk = ensemble_chunk(slab, n_p)
+        if init_labels is None or not has_init:
+            if chunk >= n_p:
+                return jax.vmap(lambda k: single(slab, k))(keys)
+            return jax.lax.map(lambda k: single(slab, k), keys,
+                               batch_size=chunk)
+        fn = lambda ki: single(slab, ki[0], init_labels=ki[1])  # noqa: E731
         if chunk >= n_p:
-            return jax.vmap(lambda k: single(slab, k))(keys)
-        return jax.lax.map(lambda k: single(slab, k), keys,
-                           batch_size=chunk)
+            return jax.vmap(fn)((keys, init_labels))
+        return jax.lax.map(fn, (keys, init_labels), batch_size=chunk)
 
+    detect.supports_init = has_init
     return detect

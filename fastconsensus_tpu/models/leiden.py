@@ -45,11 +45,15 @@ def refine(slab: GraphSlab, comm: jax.Array, key: jax.Array,
 
 
 def leiden_single(slab: GraphSlab, key: jax.Array,
+                  init_labels: jax.Array = None,
                   max_sweeps: int = 32, gamma: float = 1.0) -> jax.Array:
+    """``init_labels`` warm-starts the main move phase (the refinement and
+    aggregate phases re-derive their own inits from it as usual)."""
     n = slab.n_nodes
     k0, k1, k2 = jax.random.split(key, 3)
 
-    comm = local_move(slab, k0, max_sweeps=max_sweeps, gamma=gamma)
+    comm = local_move(slab, k0, init_labels=init_labels,
+                      max_sweeps=max_sweeps, gamma=gamma)
     # refinement re-partitions *within* converged communities — a much
     # easier problem than the main move phase, so half the sweep budget
     # suffices (quality-checked in tests/test_louvain.py leiden tests)

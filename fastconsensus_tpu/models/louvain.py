@@ -109,7 +109,10 @@ def _move_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
     # gain of node i joining C (with i removed from its current community):
     # k_i_in(C) - k_i * (Sigma_tot(C) - [i in C] k_i) / 2m
     gain = runs.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
-    score = gain + seg.uniform_jitter(k_tie, gain.shape, _JITTER_REL / m2)
+    # pair-keyed: tie-breaks must not depend on run positions, which shift
+    # with slab capacity (segment.pair_jitter)
+    score = gain + seg.pair_jitter(k_tie, runs.node, runs.label,
+                                   _JITTER_REL / m2)
 
     best, best_score, has_any = seg.argmax_label_per_node(
         runs.node, score, runs.label, runs.valid, n)
@@ -227,8 +230,10 @@ def _move_step_hash(slab: GraphSlab, labels: jax.Array, key: jax.Array,
     sig = sigma_tot[jnp.clip(lab_dst, 0, n - 1)]
     own = lab_dst == labels[src_c]
     gain = tot - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
-    score = jnp.where(valid, gain + seg.uniform_jitter(
-        k_tie, gain.shape, _JITTER_REL / m2), -jnp.inf)
+    # pair-keyed jitter: position-independent, so slab growth cannot
+    # reorder tie-breaks (see segment.pair_jitter)
+    score = jnp.where(valid, gain + seg.pair_jitter(
+        k_tie, srcd, lab_dst, _JITTER_REL / m2), -jnp.inf)
     best, best_score, has_any = seg.scatter_argmax_label(
         srcd, score, lab_dst, valid, n)
 
@@ -240,6 +245,71 @@ def _move_step_hash(slab: GraphSlab, labels: jax.Array, key: jax.Array,
 
     want = has_any & (best_score > stay + _MARGIN_REL / m2) & \
         (best != labels) & (best >= 0)
+    return best, want
+
+
+def _move_step_hybrid(hyb: da.HybridAdj, slab: GraphSlab, labels: jax.Array,
+                      key: jax.Array, m2: jax.Array, strength: jax.Array,
+                      n_buckets: int, gamma: float = 1.0
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """One synchronous sweep on the degree-partitioned layout.
+
+    Non-hub nodes (degree <= d_hyb, ~95% of nodes) run the dense-row
+    lowering over narrow Pallas-friendly rows that are *complete* for them;
+    hub nodes run the hashed lowering over the compacted hub-edge prefix
+    (ops/dense_adj.py:HybridAdj).  Same gain formula as every other path;
+    the per-sweep scatter volume drops from O(capacity) to O(hub_cap) —
+    the hash path's measured bottleneck on skewed-degree graphs
+    (~31M scatter-updates/s, BASELINE.md lfr10k).
+    """
+    n = slab.n_nodes
+    k_dense, k_hub = jax.random.split(key)
+    sigma_tot = jax.ops.segment_sum(
+        strength, jnp.clip(labels, 0, n - 1), num_segments=n)
+
+    # dense side — identical to _move_step_dense on the masked rows
+    tot = da.row_label_totals(hyb.adj, labels)
+    k_i = strength[:, None]
+    sig = sigma_tot[jnp.clip(tot.label, 0, n - 1)]
+    own = tot.label == labels[:, None]
+    gain = tot.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    jitter = seg.uniform_jitter(k_dense, gain.shape, _JITTER_REL / m2)
+    score = jnp.where(tot.is_head, gain + jitter, -jnp.inf)
+    best_d, want_d = da.best_candidate(tot, score, labels)
+    best_score_d = jnp.max(score, axis=1)
+    stay_d = jnp.max(jnp.where(own & tot.is_head, gain, -jnp.inf), axis=1)
+    want_d = want_d & (best_score_d > stay_d + _MARGIN_REL / m2)
+
+    # hub side — hashed aggregation over the compacted prefix; synthetic
+    # zero-weight stay entries for hub nodes (same invariant as
+    # _move_step_hash: every looked-up pair must be inserted)
+    nodes = jnp.arange(n, dtype=jnp.int32)
+    lab_hdst = labels[jnp.clip(hyb.hdst, 0, n - 1)]
+    tables = seg.build_hash_totals(
+        jnp.concatenate([hyb.hsrc, nodes]),
+        jnp.concatenate([lab_hdst, labels]),
+        jnp.concatenate([hyb.hw, jnp.zeros((n,), jnp.float32)]),
+        jnp.concatenate([hyb.hvalid, hyb.is_hub]),
+        n_buckets)
+    tot_h = seg.lookup_hash_totals(tables, hyb.hsrc, lab_hdst)
+    src_c = jnp.clip(hyb.hsrc, 0, n - 1)
+    k_i_h = strength[src_c]
+    sig_h = sigma_tot[jnp.clip(lab_hdst, 0, n - 1)]
+    own_h = lab_hdst == labels[src_c]
+    gain_h = tot_h - gamma * k_i_h * (sig_h -
+                                      jnp.where(own_h, k_i_h, 0.0)) / m2
+    score_h = jnp.where(hyb.hvalid, gain_h + seg.pair_jitter(
+        k_hub, hyb.hsrc, lab_hdst, _JITTER_REL / m2), -jnp.inf)
+    best_h, bs_h, has_h = seg.scatter_argmax_label(
+        hyb.hsrc, score_h, lab_hdst, hyb.hvalid, n)
+    stay_tot = seg.lookup_hash_totals(tables, nodes, labels)
+    stay_h = stay_tot - gamma * strength * (
+        sigma_tot[jnp.clip(labels, 0, n - 1)] - strength) / m2
+    want_h = has_h & (bs_h > stay_h + _MARGIN_REL / m2) & \
+        (best_h != labels) & (best_h >= 0)
+
+    best = jnp.where(hyb.is_hub, best_h, best_d)
+    want = jnp.where(hyb.is_hub, want_h, want_d)
     return best, want
 
 
@@ -335,7 +405,8 @@ def _move_step_dense_fused(fused: _FusedRows, labels: jax.Array,
 
 
 def _swap_break(key: jax.Array, slab: GraphSlab, want: jax.Array,
-                adj: "da.DenseAdj" = None) -> jax.Array:
+                adj: "da.DenseAdj" = None,
+                hyb: "da.HybridAdj" = None) -> jax.Array:
     """Keep each wanting node only if it out-prioritizes its wanting neighbors.
 
     Synchronous best-gain moves oscillate: adjacent node pairs that each
@@ -349,62 +420,108 @@ def _swap_break(key: jax.Array, slab: GraphSlab, want: jax.Array,
     the bulk is unchanged and n_want can actually hit 0.
     """
     n = slab.n_nodes
-    pri = jax.random.uniform(key, (n,))
+    k_pri, k_gate = jax.random.split(key)
+    pri = jax.random.uniform(k_pri, (n,))
     wpri = jnp.where(want, pri, -1.0)
+    if hyb is not None:
+        # hybrid: non-hub rows are complete, hub edges live in the prefix;
+        # together they cover every adjacency unless hub_cap overflowed
+        nbrp = jnp.where(hyb.adj.valid,
+                         wpri[jnp.clip(hyb.adj.nbr, 0, n - 1)], -1.0)
+        nbr_best = jnp.max(nbrp, axis=1)
+        hub_best = jnp.full((n + 1,), -1.0).at[
+            jnp.where(hyb.hvalid, hyb.hsrc, n)].max(
+            wpri[jnp.clip(hyb.hdst, 0, n - 1)], mode="drop")[:-1]
+        nbr_best = jnp.maximum(nbr_best, hub_best)
+        # overflow coin-gate (see the dense branch) only when the prefix
+        # actually overflowed, and only on hub nodes
+        gate = (hyb.n_hub_overflow == 0) | ~hyb.is_hub | \
+            jax.random.bernoulli(k_gate, 0.5, (n,))
+        return want & (wpri > nbr_best) & gate
     if adj is not None:
         # dense rows: per-row max over neighbor priorities — far cheaper
         # than the directed-edge scatter (measured 123 ms -> ~25 ms on the
         # 100k config).  Overflowed hub rows may miss a wanting neighbor
         # beyond d_cap (the same candidates the move step itself does not
-        # see); a missed swap-break there only delays convergence by a
-        # sweep, never corrupts state.
+        # see), so the priority comparison alone cannot break a swap cycle
+        # riding an overflow edge (ADVICE round 1: bounded only by
+        # max_sweeps).  Nodes whose row is full are the only ones that can
+        # be overflowing; when any overflow exists, an extra keyed coin on
+        # exactly those rows makes any surviving symmetric swap die off
+        # geometrically (P(both move) <= 1/4 per sweep) while leaving the
+        # 99%+ non-full rows untouched.
         nbrp = jnp.where(adj.valid,
                          wpri[jnp.clip(adj.nbr, 0, n - 1)], -1.0)
         nbr_best = jnp.max(nbrp, axis=1)
-    else:
-        srcd, dstd, _, ad = slab.directed()
-        valid = ad & (srcd != dstd)
-        nbr_best = jnp.full((n + 1,), -1.0).at[
-            jnp.where(valid, srcd, n)].max(
-            wpri[jnp.clip(dstd, 0, n - 1)], mode="drop")[:-1]
+        full = jnp.all(adj.valid, axis=1)
+        gate = (adj.n_overflow == 0) | ~full | \
+            jax.random.bernoulli(k_gate, 0.5, (n,))
+        return want & (wpri > nbr_best) & gate
+    srcd, dstd, _, ad = slab.directed()
+    valid = ad & (srcd != dstd)
+    nbr_best = jnp.full((n + 1,), -1.0).at[
+        jnp.where(valid, srcd, n)].max(
+        wpri[jnp.clip(dstd, 0, n - 1)], mode="drop")[:-1]
     return want & (wpri > nbr_best)
+
+
+def _cap_hint(slab: GraphSlab) -> int:
+    """Growth-stable stand-in for ``slab.capacity`` in heuristics.
+
+    Path selection and hash-table sizing must not change when the consensus
+    driver auto-grows the slab mid-run (replay determinism, graph.grow_slab)
+    or when a user pre-sizes ``--capacity`` generously — both would
+    otherwise silently change detection results.
+    """
+    return slab.cap_hint or slab.capacity
 
 
 def select_move_path(slab: GraphSlab) -> str:
     """Which per-sweep lowering :func:`local_move` will use for this slab.
 
-    One of "matmul", "dense", "hash", "runs" — best first: full-matrix MXU
-    matmul for graphs up to MATMUL_MAX_N nodes; padded dense rows when the
-    slab carries a neighbor capacity (``d_cap > 0``) *and* the padded-row
-    area is within DENSE_OVER_HASH of the directed-edge count (skewed degree
-    distributions make the rows mostly padding, and the per-sweep row sort
-    pays for the padding); hashed scatter-add aggregation otherwise
-    (hub-heavy graphs and the d_cap=0 aggregated multi-level graphs).
+    One of "matmul", "dense", "hybrid", "hash", "runs" — best first:
+    full-matrix MXU matmul for graphs up to MATMUL_MAX_N nodes; padded
+    dense rows when the slab carries a neighbor capacity (``d_cap > 0``)
+    *and* the padded-row area is within DENSE_OVER_HASH of the
+    directed-edge count (skewed degree distributions make the rows mostly
+    padding, and the per-sweep row sort pays for the padding); the
+    degree-partitioned hybrid when the slab carries hybrid sizing and its
+    *narrow* rows pass the same area test (skewed graphs — the lfr10k
+    regime where pure hash is scatter-bound); hashed scatter-add
+    aggregation otherwise (the d_cap=0 aggregated multi-level graphs).
+    All capacity-derived terms use :func:`_cap_hint` (growth-stable).
 
     FCTPU_MOVE_PATH forces a path, best-effort: a forced path that cannot
-    serve this slab (dense needs d_cap; matmul needs the N^2 matrix to fit —
-    capped at 8*MATMUL_MAX_N to keep a forced run from faulting the chip)
-    falls through to the exact sorted-run step ("runs", kept as the oracle
-    the approximate hash path is tested against).
+    serve this slab (dense needs d_cap; hybrid needs d_hyb/hub_cap; matmul
+    needs the N^2 matrix to fit — capped at 8*MATMUL_MAX_N to keep a forced
+    run from faulting the chip) falls through to the exact sorted-run step
+    ("runs", kept as the oracle the approximate hash path is tested
+    against).
 
     The single source of truth for path choice — memory budgeting
     (models/base.py:ensemble_chunk) consults it too.
     """
     n = slab.n_nodes
+    hybrid_ok = slab.d_hyb > 0 and slab.hub_cap > 0
     forced = os.environ.get("FCTPU_MOVE_PATH", "")
     if forced:
         if forced == "matmul" and n <= 8 * MATMUL_MAX_N:
             return "matmul"
         if forced == "dense" and slab.d_cap > 0:
             return "dense"
+        if forced == "hybrid" and hybrid_ok:
+            return "hybrid"
         if forced == "hash":
             return "hash"
         return "runs"
     if n <= MATMUL_MAX_N:
         return "matmul"
     if slab.d_cap > 0 and \
-            n * (slab.d_cap + 1) <= DENSE_OVER_HASH * 2 * slab.capacity:
+            n * (slab.d_cap + 1) <= DENSE_OVER_HASH * 2 * _cap_hint(slab):
         return "dense"
+    if hybrid_ok and \
+            n * (slab.d_hyb + 1) <= DENSE_OVER_HASH * 2 * _cap_hint(slab):
+        return "hybrid"
     return "hash"
 
 
@@ -420,10 +537,14 @@ def sweep_temp_bytes(slab: GraphSlab) -> int:
         return 4 * 4 * n * n
     if path == "dense":
         return 6 * 4 * n * (slab.d_cap + 1)
+    if path == "hybrid":
+        return 6 * 4 * n * (slab.d_hyb + 1) + 10 * 4 * slab.hub_cap + \
+            2 * 4 * seg.hash_buckets_for(slab.hub_cap + n)
     # hash / runs: a handful of directed-edge-sized arrays (sort operands or
-    # scatter sources) plus, for hash, the two bucket tables
+    # scatter sources) plus, for hash, the two bucket tables (sized from the
+    # growth-stable hint, matching local_move)
     return 10 * 4 * 2 * slab.capacity + \
-        2 * 4 * seg.hash_buckets_for(2 * slab.capacity + n)
+        2 * 4 * seg.hash_buckets_for(2 * _cap_hint(slab) + n)
 
 
 def local_move(slab: GraphSlab, key: jax.Array,
@@ -448,12 +569,15 @@ def local_move(slab: GraphSlab, key: jax.Array,
     n = slab.n_nodes
     if init_labels is None:
         init_labels = jnp.arange(n, dtype=jnp.int32)
+    else:
+        init_labels = init_labels.astype(jnp.int32)
     srcd, _, wd, ad = slab.directed()
     m2 = jnp.maximum(jnp.sum(jnp.where(ad, wd, 0.0)), 1e-9)
 
     path = select_move_path(slab)
     matmul = path == "matmul"
     dense = path == "dense"
+    hybrid = path == "hybrid"
     hashed = path == "hash"
     strength = slab.strengths()
     fused = None
@@ -472,8 +596,13 @@ def local_move(slab: GraphSlab, key: jax.Array,
         # work.
         if os.environ.get("FCTPU_FUSED", "") == "1" and pk.fits_vmem(d1p):
             fused = _FusedRows(slab, adj, strength, m2, gamma)
+    elif hybrid:
+        hyb = da.build_hybrid(slab)
+        n_buckets = seg.hash_buckets_for(slab.hub_cap + n)
     elif hashed:
-        n_buckets = seg.hash_buckets_for(2 * slab.capacity + n)
+        # bucket count from the growth-stable hint: auto-growth must not
+        # change the collision pattern (and thus labels) mid-run
+        n_buckets = seg.hash_buckets_for(2 * _cap_hint(slab) + n)
 
     stop_at = jnp.int32(max(1, int(stop_frac * n)))
 
@@ -494,6 +623,9 @@ def local_move(slab: GraphSlab, key: jax.Array,
         elif dense:
             best, want = _move_step_dense(
                 adj, slab, labels, k_step, m2, strength, gamma)
+        elif hybrid:
+            best, want = _move_step_hybrid(
+                hyb, slab, labels, k_step, m2, strength, n_buckets, gamma)
         elif hashed:
             best, want = _move_step_hash(
                 slab, labels, k_step, m2, strength, n_buckets, gamma)
@@ -513,7 +645,8 @@ def local_move(slab: GraphSlab, key: jax.Array,
         # (both branches execute regardless) and only adds overhead
         # (measured +70% on the 100k config).
         bern = jax.random.bernoulli(k_mask, update_prob, (n,))
-        swap = _swap_break(k_pri, slab, want, adj if dense else None)
+        swap = _swap_break(k_pri, slab, want, adj if dense else None,
+                           hyb if hybrid else None)
         mask = jnp.where(endgame, swap, bern)
         return jnp.where(want & mask, best, labels), it + 1, n_want
 
@@ -536,11 +669,14 @@ def aggregate(slab: GraphSlab, labels: jax.Array) -> GraphSlab:
     u = jnp.minimum(cu, cv)
     v = jnp.maximum(cu, cv)
     runs = seg.node_label_runs(u, v, slab.weight, slab.alive, n)
-    # d_cap=0: supernode degrees can exceed any per-node cap, so multi-level
-    # moves on aggregated graphs take the sorted-run path.
-    return GraphSlab(src=jnp.where(runs.valid, runs.node, 0),
-                     dst=jnp.where(runs.valid, runs.label, 0),
-                     weight=runs.total, alive=runs.valid, n_nodes=n, d_cap=0)
+    # d_cap/d_hyb = 0: supernode degrees can exceed any per-node cap, so
+    # multi-level moves on aggregated graphs take the hash/sorted-run paths.
+    import dataclasses
+
+    return dataclasses.replace(
+        slab, src=jnp.where(runs.valid, runs.node, 0),
+        dst=jnp.where(runs.valid, runs.label, 0),
+        weight=runs.total, alive=runs.valid, d_cap=0, d_hyb=0, hub_cap=0)
 
 
 def modularity_levels(slab: GraphSlab, key: jax.Array, n_levels: int = 2,
@@ -567,15 +703,20 @@ def modularity_levels(slab: GraphSlab, key: jax.Array, n_levels: int = 2,
 
 
 def louvain_single(slab: GraphSlab, key: jax.Array,
+                   init_labels: jax.Array = None,
                    max_sweeps: int = 32, update_prob: float = 0.5,
                    gamma: float = 1.0) -> jax.Array:
     """Level-0 partition (parity with partition_at_level(dend, 0), fc:148).
 
     ``gamma`` is the resolution parameter (gain = k_i_in - gamma k_i
     Sigma_tot / 2m): the reference parses ``-g`` but never uses it
-    (merged_consensus.py:284-285, SURVEY.md 2.22.10); here it works."""
+    (merged_consensus.py:284-285, SURVEY.md 2.22.10); here it works.
+
+    ``init_labels`` warm-starts the sweeps (consensus rounds reuse the
+    previous round's labels; None = singleton start, identical to the
+    reference's from-scratch runs)."""
     return seg.compact_labels(
-        local_move(slab, key, max_sweeps=max_sweeps,
+        local_move(slab, key, init_labels=init_labels, max_sweeps=max_sweeps,
                    update_prob=update_prob, gamma=gamma), slab.n_nodes)
 
 

@@ -39,7 +39,9 @@ def _vote_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
     srcd, dstd, wd, ad = slab.directed()
     k_tie, k_mask = jax.random.split(key)
     runs = seg.node_label_runs(srcd, labels[dstd], wd, ad, n)
-    score = runs.total + seg.uniform_jitter(k_tie, runs.total.shape, 0.5)
+    # pair-keyed: position-based jitter would change tie-breaks when the
+    # slab grows (segment.pair_jitter / graph.grow_slab)
+    score = runs.total + seg.pair_jitter(k_tie, runs.node, runs.label, 0.5)
     best, _, has_any = seg.argmax_label_per_node(
         runs.node, score, runs.label, runs.valid, n)
     want = has_any & (best != labels)
@@ -69,10 +71,17 @@ def _vote_step_dense(adj: da.DenseAdj, labels: jax.Array, key: jax.Array,
 
 
 def lpm_single(slab: GraphSlab, key: jax.Array,
+               init_labels: jax.Array = None,
                max_iters: int = 64, update_prob: float = 0.7) -> jax.Array:
-    """One label-propagation partition; labels int32[N] (not compacted)."""
+    """One label-propagation partition; labels int32[N] (not compacted).
+
+    ``init_labels`` warm-starts the vote iteration (None = every node its
+    own label, the igraph initial condition)."""
     n = slab.n_nodes
-    init_labels = jnp.arange(n, dtype=jnp.int32)
+    if init_labels is None:
+        init_labels = jnp.arange(n, dtype=jnp.int32)
+    else:
+        init_labels = init_labels.astype(jnp.int32)
 
     dense = slab.d_cap > 0
     if dense:

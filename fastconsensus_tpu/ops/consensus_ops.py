@@ -17,6 +17,7 @@ to the edge slab (the paper's sparse-consensus trick, arXiv:1902.04014).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import NamedTuple, Tuple
 
 import jax
@@ -174,8 +175,8 @@ def insert_edges(slab: GraphSlab,
     weight = slab.weight.at[slot].set(cand_w.astype(jnp.float32), mode="drop")
     alive = slab.alive.at[slot].set(True, mode="drop")
     n_dropped = jnp.sum(surv.astype(jnp.int32)) - jnp.sum(ok.astype(jnp.int32))
-    new_slab = GraphSlab(src=src, dst=dst, weight=weight, alive=alive,
-                         n_nodes=n, d_cap=slab.d_cap)
+    new_slab = dataclasses.replace(slab, src=src, dst=dst, weight=weight,
+                                   alive=alive)
     return new_slab, n_dropped
 
 

@@ -43,13 +43,24 @@ class DenseAdj(NamedTuple):
     n_overflow: jax.Array # int32[] directed edges dropped for row width
 
 
-def build_dense_adjacency(slab: GraphSlab) -> DenseAdj:
-    """Scatter alive directed edges into [N, d_cap] rows (one global sort)."""
-    if slab.d_cap <= 0:
-        raise ValueError("slab.d_cap is 0; pack with pack_edges or set d_cap")
-    n, d = slab.n_nodes, slab.d_cap
+def build_dense_adjacency(slab: GraphSlab,
+                          width: int = 0,
+                          edge_mask: jax.Array = None) -> DenseAdj:
+    """Scatter alive directed edges into [N, width] rows (one global sort).
+
+    ``width`` defaults to ``slab.d_cap``.  ``edge_mask`` (bool[2*capacity],
+    aligned with ``slab.directed()``) restricts which directed edges enter
+    the rows — the hybrid path passes the non-hub-source mask so hub rows
+    stay empty (their candidates go through hashed aggregation instead).
+    """
+    d = width or slab.d_cap
+    if d <= 0:
+        raise ValueError("row width is 0; pack with pack_edges or set d_cap")
+    n = slab.n_nodes
     srcd, dstd, wd, ad = slab.directed()
     ad = ad & (srcd != dstd)  # self-loops never vote
+    if edge_mask is not None:
+        ad = ad & edge_mask
     key = jnp.where(ad, srcd, n)
     order = jnp.argsort(key)
     ssrc = key[order]
@@ -70,6 +81,62 @@ def build_dense_adjacency(slab: GraphSlab) -> DenseAdj:
         True, mode="drop")[:-1].reshape(n, d)
     n_overflow = jnp.sum(((ssrc < n) & ~ok).astype(jnp.int32))
     return DenseAdj(nbr=nbr, w=w, valid=valid, n_overflow=n_overflow)
+
+
+class HybridAdj(NamedTuple):
+    """Degree-partitioned adjacency: complete dense rows for nodes with
+    degree <= d_hyb, plus a compacted directed-edge prefix for the hubs.
+
+    The hash move path's per-sweep cost is O(capacity) scatter work
+    regardless of how few nodes are actually hub-like; this layout confines
+    the scatters to the hub edges (a small static budget, slab.hub_cap) and
+    serves the ~95% low-degree nodes from narrow Pallas-friendly rows.
+    Non-hub rows are complete by construction (degree <= row width), so the
+    dense side is exact; the hub side inherits the hash tables' documented
+    collision approximation (ops/segment.py:HashTables).
+    """
+
+    adj: DenseAdj         # [N, d_hyb] rows; empty for hub nodes
+    is_hub: jax.Array     # bool[N] degree > d_hyb at build time
+    hsrc: jax.Array       # int32[hub_cap] compacted hub-source directed edges
+    hdst: jax.Array       # int32[hub_cap]
+    hw: jax.Array         # float32[hub_cap]
+    hvalid: jax.Array     # bool[hub_cap]
+    n_hub_overflow: jax.Array  # int32[] hub edges dropped for hub_cap
+
+
+def build_hybrid(slab: GraphSlab) -> HybridAdj:
+    """Partition directed edges by source degree (one global sort, built
+    once per detection call like build_dense_adjacency)."""
+    if slab.d_hyb <= 0 or slab.hub_cap <= 0:
+        raise ValueError("slab carries no hybrid sizing (d_hyb/hub_cap); "
+                         "pack with pack_edges")
+    n = slab.n_nodes
+    degrees = slab.degrees()
+    is_hub = degrees > slab.d_hyb
+
+    srcd, dstd, wd, ad = slab.directed()
+    ad = ad & (srcd != dstd)
+    hub_src = is_hub[jnp.clip(srcd, 0, n - 1)]
+    adj = build_dense_adjacency(slab, width=slab.d_hyb,
+                                edge_mask=~hub_src)
+
+    # Compact hub edges into the static prefix.  Stable sort keeps slot
+    # order, but nothing downstream depends on position (tie-breaks are
+    # pair-keyed, sums are exact integers), so growth stays
+    # result-preserving except *which* overflow edges drop when hub_cap
+    # saturates (counted below, surfaced like RoundStats.n_overflow).
+    hub_e = ad & hub_src
+    order = jnp.argsort(jnp.where(hub_e, 0, 1), stable=True)
+    take = order[:slab.hub_cap]
+    hvalid = hub_e[take]
+    hsrc = jnp.where(hvalid, srcd[take], n)
+    hdst = jnp.where(hvalid, dstd[take], n)
+    hw = jnp.where(hvalid, wd[take], 0.0)
+    n_hub = jnp.sum(hub_e.astype(jnp.int32))
+    n_hub_overflow = jnp.maximum(n_hub - slab.hub_cap, 0)
+    return HybridAdj(adj=adj, is_hub=is_hub, hsrc=hsrc, hdst=hdst, hw=hw,
+                     hvalid=hvalid, n_hub_overflow=n_hub_overflow)
 
 
 class RowTotals(NamedTuple):

@@ -203,6 +203,30 @@ def argmax_label_per_node(runs_node: jax.Array,
     return best_label, best, has_any
 
 
+def pair_jitter(key: jax.Array, node: jax.Array, label: jax.Array,
+                scale) -> jax.Array:
+    """Keyed tie-break noise in [0, scale), derived from the (node, label)
+    *content* rather than the array slot.
+
+    :func:`uniform_jitter` draws per-position noise, which silently depends
+    on array layout: growing the edge slab (graph.grow_slab) shifts the
+    second orientation half of the directed arrays by the capacity delta, so
+    tied candidates would win differently before and after growth.  Hashing
+    the pair (salted per call from ``key``) makes the draw
+    position-independent — and gives duplicate candidates (several edges
+    from one node into the same community) identical noise, which is the
+    correct tie-break semantics anyway.
+    """
+    salt = jax.random.bits(key, (2,), jnp.uint32)
+    m = (node.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         + label.astype(jnp.uint32) * jnp.uint32(0x85EBCA77) + salt[0])
+    m = m ^ (m >> 15)
+    m = m * jnp.uint32(0x2C1B3C6D) + salt[1]
+    m = m ^ (m >> 13)
+    # top 24 bits -> exact float32 in [0, 1)
+    return (m >> 8).astype(jnp.float32) * (scale / jnp.float32(1 << 24))
+
+
 def uniform_jitter(key: jax.Array, shape, scale: float = 1e-3) -> jax.Array:
     """Keyed tie-break noise, strictly inside [0, scale).
 

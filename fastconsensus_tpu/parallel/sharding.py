@@ -83,17 +83,11 @@ def slab_sharding(mesh: Mesh) -> NamedSharding:
 
 def shard_slab(slab: GraphSlab, mesh: Mesh) -> GraphSlab:
     """Place a slab on the mesh (pads capacity to the edge-axis multiple)."""
+    from fastconsensus_tpu.graph import grow_slab
+
     e = mesh.shape[EDGE_AXIS]
-    cap = slab.capacity
-    padded = math.ceil(cap / e) * e
-    if padded != cap:
-        pad = padded - cap
-        slab = GraphSlab(
-            src=jnp.pad(slab.src, (0, pad)),
-            dst=jnp.pad(slab.dst, (0, pad)),
-            weight=jnp.pad(slab.weight, (0, pad)),
-            alive=jnp.pad(slab.alive, (0, pad)),
-            n_nodes=slab.n_nodes, d_cap=slab.d_cap)
+    padded = math.ceil(slab.capacity / e) * e
+    slab = grow_slab(slab, padded)  # dead-slot tail; result-preserving
     return jax.device_put(slab, slab_sharding(mesh))
 
 

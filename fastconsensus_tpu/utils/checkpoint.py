@@ -31,12 +31,20 @@ def save_checkpoint(path: str,
                     rounds_done: int,
                     key_data: np.ndarray,
                     history: List[dict],
-                    extra: Optional[Dict[str, Any]] = None) -> None:
-    """Atomically persist the consensus state after a round."""
+                    extra: Optional[Dict[str, Any]] = None,
+                    labels: Optional[np.ndarray] = None) -> None:
+    """Atomically persist the consensus state after a round.
+
+    ``labels`` ([n_p, N] int32, optional) is the round's detection output —
+    persisted so a warm-started run (consensus.ConsensusConfig.warm_start)
+    resumes bit-identically; surfaced by load_checkpoint as
+    ``extra["_labels"]``.
+    """
     meta = {
         "version": _FORMAT_VERSION,
         "n_nodes": int(slab.n_nodes),
         "d_cap": int(slab.d_cap),
+        "cap_hint": int(slab.cap_hint),
         "rounds_done": int(rounds_done),
         "history": history,
         "extra": extra or {},
@@ -44,16 +52,18 @@ def save_checkpoint(path: str,
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)),
                                suffix=".tmp")
+    arrays = dict(src=np.asarray(slab.src),
+                  dst=np.asarray(slab.dst),
+                  weight=np.asarray(slab.weight),
+                  alive=np.asarray(slab.alive),
+                  key_data=np.asarray(key_data),
+                  meta=np.frombuffer(
+                      json.dumps(meta).encode(), dtype=np.uint8))
+    if labels is not None:
+        arrays["labels"] = np.asarray(labels)
     try:
         with os.fdopen(fd, "wb") as fh:
-            np.savez(fh,
-                     src=np.asarray(slab.src),
-                     dst=np.asarray(slab.dst),
-                     weight=np.asarray(slab.weight),
-                     alive=np.asarray(slab.alive),
-                     key_data=np.asarray(key_data),
-                     meta=np.frombuffer(
-                         json.dumps(meta).encode(), dtype=np.uint8))
+            np.savez(fh, **arrays)
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
@@ -77,6 +87,10 @@ def load_checkpoint(path: str
                          weight=jnp.asarray(z["weight"]),
                          alive=jnp.asarray(z["alive"]),
                          n_nodes=int(meta["n_nodes"]),
-                         d_cap=int(meta.get("d_cap", 0)))
+                         d_cap=int(meta.get("d_cap", 0)),
+                         cap_hint=int(meta.get("cap_hint", 0)))
+        extra = dict(meta["extra"])
+        if "labels" in z.files:
+            extra["_labels"] = z["labels"].copy()
         return (slab, int(meta["rounds_done"]), z["key_data"].copy(),
-                list(meta["history"]), dict(meta["extra"]))
+                list(meta["history"]), extra)

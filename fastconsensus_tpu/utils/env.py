@@ -1,0 +1,24 @@
+"""Typed FCTPU_* environment-knob parsing with named errors.
+
+Bare ``int(os.environ[...])`` raises an anonymous ValueError deep inside the
+consensus driver when a knob is malformed; these helpers name the variable
+and the offending value so a typo reads as a configuration error, not a
+crash (ADVICE round 1).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def env_int(name: str, default: Optional[int] = None) -> Optional[int]:
+    """Integer env knob; unset/empty returns ``default``."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"environment variable {name}={raw!r} is not an integer") from None

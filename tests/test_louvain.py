@@ -152,13 +152,55 @@ def test_move_path_parity(monkeypatch):
     slab = pack_edges(edges, 400)
     keys = jax.random.split(jax.random.key(0), 4)
     scores = {}
-    for path in ("matmul", "hash", "runs"):
+    for path in ("matmul", "hash", "hybrid", "runs"):
         monkeypatch.setenv("FCTPU_MOVE_PATH", path)
+        from fastconsensus_tpu.models import louvain as lv
+
+        assert lv.select_move_path(slab) == path
         labels = np.asarray(jax.vmap(
             lambda k: louvain_single(slab, k))(keys))
         scores[path] = float(np.mean([nmi(l, truth) for l in labels]))
     assert scores["hash"] > 0.9, scores
+    assert scores["hybrid"] > 0.9, scores
     assert abs(scores["hash"] - scores["runs"]) < 0.08, scores
+    assert abs(scores["hybrid"] - scores["runs"]) < 0.08, scores
+
+
+def test_hybrid_on_skewed_degrees():
+    """Hybrid's regime: a hub-heavy graph (star cores + communities).  The
+    hub side (hashed prefix) and dense side must cooperate: quality close
+    to the exact sorted-run oracle on the same slab."""
+    import os
+
+    from fastconsensus_tpu.models import louvain as lv
+    from fastconsensus_tpu.utils.synth import planted_partition
+
+    rng = np.random.default_rng(0)
+    edges, truth = planted_partition(600, 6, 0.12, 0.004, seed=5)
+    # graft 6 hubs: node h connects to 150 random others
+    hubs = rng.choice(600, 6, replace=False)
+    extra = np.array([[h, int(o)] for h in hubs
+                      for o in rng.choice(600, 150, replace=False)
+                      if int(o) != h])
+    all_edges = np.vstack([edges, extra])
+    slab = pack_edges(all_edges, 600)
+    assert slab.d_hyb > 0 and slab.hub_cap > 0
+    keys = jax.random.split(jax.random.key(1), 4)
+
+    prev = os.environ.get("FCTPU_MOVE_PATH")
+    try:
+        os.environ["FCTPU_MOVE_PATH"] = "hybrid"
+        hyb = np.asarray(jax.vmap(lambda k: louvain_single(slab, k))(keys))
+        os.environ["FCTPU_MOVE_PATH"] = "runs"
+        exact = np.asarray(jax.vmap(lambda k: louvain_single(slab, k))(keys))
+    finally:
+        os.environ.pop("FCTPU_MOVE_PATH", None)
+        if prev is not None:
+            os.environ["FCTPU_MOVE_PATH"] = prev
+    s_h = float(np.mean([nmi(l, truth) for l in hyb]))
+    s_e = float(np.mean([nmi(l, truth) for l in exact]))
+    assert s_h > 0.8, (s_h, s_e)
+    assert s_h > s_e - 0.08, (s_h, s_e)
 
 
 def test_select_move_path_forced_fallbacks(monkeypatch):
