@@ -64,6 +64,21 @@ MATMUL_MAX_N = 1024
 DENSE_OVER_HASH = 8
 
 
+def _theta_score(gain: jax.Array, noise_u: jax.Array, valid: jax.Array,
+                 theta: float, m2: jax.Array) -> jax.Array:
+    """Candidate scores for theta-randomized refinement (Leiden).
+
+    Restricted to strictly-positive gains and Gumbel-perturbed: the argmax
+    then samples a candidate with probability proportional to
+    exp(gain * 2m / theta) — leidenalg's merge distribution
+    (fast_consensus.py:121-123 semantics; theta in leidenalg's unnormalized
+    gain units, our gains being /2m-normalized).
+    """
+    g = seg.gumbel_from_uniform(noise_u)
+    return jnp.where(valid & (gain > 0),
+                     gain + (jnp.float32(theta) / m2) * g, -jnp.inf)
+
+
 def _gain_runs(slab: GraphSlab, labels: jax.Array
                ) -> Tuple[seg.Runs, jax.Array, jax.Array]:
     """Candidate runs (i, C, k_i_in(C)) + node strengths + community totals.
@@ -92,12 +107,14 @@ def _gain_runs(slab: GraphSlab, labels: jax.Array
 
 
 def _move_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
-               m2: jax.Array, gamma: float = 1.0
+               m2: jax.Array, gamma: float = 1.0, theta: float = 0.0
                ) -> Tuple[jax.Array, jax.Array]:
     """One synchronous sweep via the exact sorted-run reduction.
 
     Returns ``(best_label, want)``; the caller (local_move) decides which
-    wanted moves to apply (swap-break masking).
+    wanted moves to apply (swap-break masking).  ``theta > 0`` switches to
+    refinement scoring (:func:`_theta_score`): positive-gain candidates
+    only, Gumbel-sampled, no stay margin.
     """
     n = slab.n_nodes
     k_tie = key
@@ -109,6 +126,12 @@ def _move_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
     # gain of node i joining C (with i removed from its current community):
     # k_i_in(C) - k_i * (Sigma_tot(C) - [i in C] k_i) / 2m
     gain = runs.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    if theta > 0.0:
+        u = seg.pair_jitter(k_tie, runs.node, runs.label, 1.0)
+        score = _theta_score(gain, u, runs.valid & ~own, theta, m2)
+        best, _, has_any = seg.argmax_label_per_node(
+            runs.node, score, runs.label, runs.valid, n)
+        return best, has_any & (best >= 0) & (best != labels)
     # pair-keyed: tie-breaks must not depend on run positions, which shift
     # with slab capacity (segment.pair_jitter)
     score = gain + seg.pair_jitter(k_tie, runs.node, runs.label,
@@ -145,7 +168,7 @@ def _dense_weights(slab: GraphSlab) -> jax.Array:
 
 def _move_step_matmul(W: jax.Array, labels: jax.Array, key: jax.Array,
                       m2: jax.Array, strength: jax.Array,
-                      gamma: float = 1.0
+                      gamma: float = 1.0, theta: float = 0.0
                       ) -> Tuple[jax.Array, jax.Array]:
     """One synchronous sweep via one MXU matmul (graphs with N <= MATMUL_MAX_N).
 
@@ -171,6 +194,13 @@ def _move_step_matmul(W: jax.Array, labels: jax.Array, key: jax.Array,
     k_i = strength[:, None]
     gain = s - gamma * k_i * (
         sigma_tot[None, :] - jnp.where(own, k_i, 0.0)) / m2
+    if theta > 0.0:
+        u = seg.uniform_jitter(k_tie, gain.shape, 1.0)
+        score = _theta_score(gain, u, (s > 0) & ~own, theta, m2)
+        best = jnp.argmax(score, axis=1).astype(jnp.int32)
+        best_score = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0]
+        has = jnp.isfinite(best_score)
+        return jnp.where(has, best, labels), has & (best != labels)
     score = jnp.where((s > 0) | own,
                       gain + seg.uniform_jitter(k_tie, gain.shape,
                                                 _JITTER_REL / m2),
@@ -185,7 +215,7 @@ def _move_step_matmul(W: jax.Array, labels: jax.Array, key: jax.Array,
 
 def _move_step_hash(slab: GraphSlab, labels: jax.Array, key: jax.Array,
                     m2: jax.Array, strength: jax.Array, n_buckets: int,
-                    gamma: float = 1.0
+                    gamma: float = 1.0, theta: float = 0.0
                     ) -> Tuple[jax.Array, jax.Array]:
     """One synchronous sweep via hashed scatter-adds — no sorts at all.
 
@@ -230,6 +260,12 @@ def _move_step_hash(slab: GraphSlab, labels: jax.Array, key: jax.Array,
     sig = sigma_tot[jnp.clip(lab_dst, 0, n - 1)]
     own = lab_dst == labels[src_c]
     gain = tot - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    if theta > 0.0:
+        u = seg.pair_jitter(k_tie, srcd, lab_dst, 1.0)
+        score = _theta_score(gain, u, valid & ~own, theta, m2)
+        best, _, has_any = seg.scatter_argmax_label(
+            srcd, score, lab_dst, valid, n)
+        return best, has_any & (best >= 0) & (best != labels)
     # pair-keyed jitter: position-independent, so slab growth cannot
     # reorder tie-breaks (see segment.pair_jitter)
     score = jnp.where(valid, gain + seg.pair_jitter(
@@ -250,7 +286,7 @@ def _move_step_hash(slab: GraphSlab, labels: jax.Array, key: jax.Array,
 
 def _move_step_hybrid(hyb: da.HybridAdj, slab: GraphSlab, labels: jax.Array,
                       key: jax.Array, m2: jax.Array, strength: jax.Array,
-                      n_buckets: int, gamma: float = 1.0
+                      n_buckets: int, gamma: float = 1.0, theta: float = 0.0
                       ) -> Tuple[jax.Array, jax.Array]:
     """One synchronous sweep on the degree-partitioned layout.
 
@@ -273,12 +309,18 @@ def _move_step_hybrid(hyb: da.HybridAdj, slab: GraphSlab, labels: jax.Array,
     sig = sigma_tot[jnp.clip(tot.label, 0, n - 1)]
     own = tot.label == labels[:, None]
     gain = tot.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
-    jitter = seg.uniform_jitter(k_dense, gain.shape, _JITTER_REL / m2)
-    score = jnp.where(tot.is_head, gain + jitter, -jnp.inf)
-    best_d, want_d = da.best_candidate(tot, score, labels)
-    best_score_d = jnp.max(score, axis=1)
-    stay_d = jnp.max(jnp.where(own & tot.is_head, gain, -jnp.inf), axis=1)
-    want_d = want_d & (best_score_d > stay_d + _MARGIN_REL / m2)
+    if theta > 0.0:
+        u = seg.uniform_jitter(k_dense, gain.shape, 1.0)
+        score = _theta_score(gain, u, tot.is_head & ~own, theta, m2)
+        best_d, want_d = da.best_candidate(tot, score, labels)
+    else:
+        jitter = seg.uniform_jitter(k_dense, gain.shape, _JITTER_REL / m2)
+        score = jnp.where(tot.is_head, gain + jitter, -jnp.inf)
+        best_d, want_d = da.best_candidate(tot, score, labels)
+        best_score_d = jnp.max(score, axis=1)
+        stay_d = jnp.max(jnp.where(own & tot.is_head, gain, -jnp.inf),
+                         axis=1)
+        want_d = want_d & (best_score_d > stay_d + _MARGIN_REL / m2)
 
     # hub side — hashed aggregation over the compacted prefix; synthetic
     # zero-weight stay entries for hub nodes (same invariant as
@@ -298,15 +340,22 @@ def _move_step_hybrid(hyb: da.HybridAdj, slab: GraphSlab, labels: jax.Array,
     own_h = lab_hdst == labels[src_c]
     gain_h = tot_h - gamma * k_i_h * (sig_h -
                                       jnp.where(own_h, k_i_h, 0.0)) / m2
-    score_h = jnp.where(hyb.hvalid, gain_h + seg.pair_jitter(
-        k_hub, hyb.hsrc, lab_hdst, _JITTER_REL / m2), -jnp.inf)
-    best_h, bs_h, has_h = seg.scatter_argmax_label(
-        hyb.hsrc, score_h, lab_hdst, hyb.hvalid, n)
-    stay_tot = seg.lookup_hash_totals(tables, nodes, labels)
-    stay_h = stay_tot - gamma * strength * (
-        sigma_tot[jnp.clip(labels, 0, n - 1)] - strength) / m2
-    want_h = has_h & (bs_h > stay_h + _MARGIN_REL / m2) & \
-        (best_h != labels) & (best_h >= 0)
+    if theta > 0.0:
+        u = seg.pair_jitter(k_hub, hyb.hsrc, lab_hdst, 1.0)
+        score_h = _theta_score(gain_h, u, hyb.hvalid & ~own_h, theta, m2)
+        best_h, _, has_h = seg.scatter_argmax_label(
+            hyb.hsrc, score_h, lab_hdst, hyb.hvalid, n)
+        want_h = has_h & (best_h >= 0) & (best_h != labels)
+    else:
+        score_h = jnp.where(hyb.hvalid, gain_h + seg.pair_jitter(
+            k_hub, hyb.hsrc, lab_hdst, _JITTER_REL / m2), -jnp.inf)
+        best_h, bs_h, has_h = seg.scatter_argmax_label(
+            hyb.hsrc, score_h, lab_hdst, hyb.hvalid, n)
+        stay_tot = seg.lookup_hash_totals(tables, nodes, labels)
+        stay_h = stay_tot - gamma * strength * (
+            sigma_tot[jnp.clip(labels, 0, n - 1)] - strength) / m2
+        want_h = has_h & (bs_h > stay_h + _MARGIN_REL / m2) & \
+            (best_h != labels) & (best_h >= 0)
 
     best = jnp.where(hyb.is_hub, best_h, best_d)
     want = jnp.where(hyb.is_hub, want_h, want_d)
@@ -315,7 +364,7 @@ def _move_step_hybrid(hyb: da.HybridAdj, slab: GraphSlab, labels: jax.Array,
 
 def _move_step_dense(adj: da.DenseAdj, slab: GraphSlab, labels: jax.Array,
                      key: jax.Array, m2: jax.Array, strength: jax.Array,
-                     gamma: float = 1.0
+                     gamma: float = 1.0, theta: float = 0.0
                      ) -> Tuple[jax.Array, jax.Array]:
     """One synchronous sweep on the padded dense adjacency.
 
@@ -334,6 +383,10 @@ def _move_step_dense(adj: da.DenseAdj, slab: GraphSlab, labels: jax.Array,
     sig = sigma_tot[jnp.clip(tot.label, 0, n - 1)]
     own = tot.label == labels[:, None]
     gain = tot.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    if theta > 0.0:
+        u = seg.uniform_jitter(k_tie, gain.shape, 1.0)
+        score = _theta_score(gain, u, tot.is_head & ~own, theta, m2)
+        return da.best_candidate(tot, score, labels)
     jitter = seg.uniform_jitter(k_tie, gain.shape, _JITTER_REL / m2)
     score = jnp.where(tot.is_head, gain + jitter, -jnp.inf)
 
@@ -550,7 +603,9 @@ def sweep_temp_bytes(slab: GraphSlab) -> int:
 def local_move(slab: GraphSlab, key: jax.Array,
                init_labels: jax.Array = None,
                max_sweeps: int = 32, update_prob: float = 0.5,
-               gamma: float = 1.0, stop_frac: float = 0.0) -> jax.Array:
+               gamma: float = 1.0, stop_frac: float = 0.0,
+               theta: float = 0.0,
+               singleton_only: bool = False) -> jax.Array:
     """Run sweeps until (almost) no node can improve, or max_sweeps.
     Labels are community ids in [0, N); not compacted.
 
@@ -565,6 +620,15 @@ def local_move(slab: GraphSlab, key: jax.Array,
     per-member inconsistency costs far more consensus rounds than the
     sweeps saved (measured on LFR-1k: stop_frac=0.02 turned a 4-round
     consensus into 16 rounds).  Exposed for single-shot detection uses.
+
+    ``theta`` + ``singleton_only`` switch to Leiden refinement mode
+    (models/leiden.py): candidates restricted to strictly-positive gains
+    and Gumbel-sampled proportional to exp(gain/theta) (_theta_score), and
+    only nodes whose community is a singleton at sweep start may move.
+    Grouped nodes never move again, so every group grows purely by
+    accretion of nodes with an edge into it — refined communities are
+    internally connected *by construction* (leidenalg's guarantee,
+    fast_consensus.py:121-123; property test in tests/test_louvain.py).
     """
     n = slab.n_nodes
     if init_labels is None:
@@ -594,7 +658,8 @@ def local_move(slab: GraphSlab, key: jax.Array,
         # buys nothing and the kernel overheads cost.  Kept (with its
         # parity test) as the starting point for future in-kernel-gather
         # work.
-        if os.environ.get("FCTPU_FUSED", "") == "1" and pk.fits_vmem(d1p):
+        if os.environ.get("FCTPU_FUSED", "") == "1" and pk.fits_vmem(d1p) \
+                and theta == 0.0:  # fused kernel has no refinement scoring
             fused = _FusedRows(slab, adj, strength, m2, gamma)
     elif hybrid:
         hyb = da.build_hybrid(slab)
@@ -616,21 +681,29 @@ def local_move(slab: GraphSlab, key: jax.Array,
             jax.random.fold_in(key, it), 3)
         if matmul:
             best, want = _move_step_matmul(
-                W, labels, k_step, m2, strength, gamma)
+                W, labels, k_step, m2, strength, gamma, theta)
         elif dense and fused is not None:
             best, want = _move_step_dense_fused(
                 fused, labels, k_step, strength)
         elif dense:
             best, want = _move_step_dense(
-                adj, slab, labels, k_step, m2, strength, gamma)
+                adj, slab, labels, k_step, m2, strength, gamma, theta)
         elif hybrid:
             best, want = _move_step_hybrid(
-                hyb, slab, labels, k_step, m2, strength, n_buckets, gamma)
+                hyb, slab, labels, k_step, m2, strength, n_buckets, gamma,
+                theta)
         elif hashed:
             best, want = _move_step_hash(
-                slab, labels, k_step, m2, strength, n_buckets, gamma)
+                slab, labels, k_step, m2, strength, n_buckets, gamma, theta)
         else:
-            best, want = _move_step(slab, labels, k_step, m2, gamma)
+            best, want = _move_step(slab, labels, k_step, m2, gamma, theta)
+        if singleton_only:
+            # refinement: grouped nodes are frozen — groups grow only by
+            # accretion, which is what guarantees internal connectivity
+            sizes = jnp.zeros((n + 1,), jnp.int32).at[
+                jnp.clip(labels, 0, n)].add(1, mode="drop")
+            lab_c = jnp.clip(labels, 0, n - 1)
+            want = want & (sizes[lab_c] == 1)
         n_want = jnp.sum(want.astype(jnp.int32))
         # Adaptive masking: while many nodes want to move (early, chaotic
         # phase) a bernoulli(update_prob) subsample merges fastest — swap
@@ -639,6 +712,22 @@ def local_move(slab: GraphSlab, key: jax.Array,
         # so the endgame switches to priority swap-breaking, which makes
         # adjacent simultaneous moves impossible and lets n_want actually
         # reach 0.
+        if singleton_only:
+            # Joiner/anchor coin split: only joiner-coined nodes may move,
+            # and a singleton group whose member is joiner-coined may not
+            # be joined — so a move's target group is guaranteed stationary
+            # this sweep.  Without it, several joiners targeting a node
+            # that simultaneously departs end up grouped but pairwise
+            # disconnected (caught by the connectivity property test).
+            # Symmetric merge pairs resolve in expected two sweeps.
+            coin = jax.random.bernoulli(k_mask, 0.5, (n,))
+            # `want` is already singleton-gated, so want & coin is exactly
+            # the superset of nodes that may depart this sweep
+            departing_label = jnp.zeros((n + 1,), bool).at[
+                jnp.clip(labels, 0, n)].max(want & coin, mode="drop")[:-1]
+            ok = want & coin & \
+                ~departing_label[jnp.clip(best, 0, n - 1)]
+            return jnp.where(ok, best, labels), it + 1, n_want
         endgame = n_want <= jnp.int32(max(1, int(0.05 * n)))
         # Both mask variants are computed and selected with where: a
         # lax.cond here gets batched into select_n under the ensemble vmap
@@ -720,11 +809,32 @@ def louvain_single(slab: GraphSlab, key: jax.Array,
                    update_prob=update_prob, gamma=gamma), slab.n_nodes)
 
 
+def warm_sweep_budget(default: int = 12) -> int:
+    """Sweep cap for warm-started rounds (FCTPU_WARM_SWEEPS overrides).
+
+    Under the ensemble vmap the sweep while-loop runs until the *slowest*
+    member exits, so warm-started members' early exits buy nothing while a
+    single straggler churns to max_sweeps (measured: warm round-2 detection
+    as slow as cold round-1 on lfr10k).  Rounds >= 1 therefore run a
+    capped-sweep detector variant: warm members need only adapt the
+    previous round's labels to a modestly-changed graph, and a member that
+    genuinely needs more sweeps simply carries its progress into the next
+    round's warm start.
+    """
+    from fastconsensus_tpu.utils.env import env_int
+
+    return max(1, env_int("FCTPU_WARM_SWEEPS", default))
+
+
 def make_louvain(max_sweeps: int = 32, update_prob: float = 0.5,
                  gamma: float = 1.0) -> Detector:
-    return ensemble(functools.partial(
+    det = ensemble(functools.partial(
         louvain_single, max_sweeps=max_sweeps, update_prob=update_prob,
         gamma=gamma))
+    det.warm_variant = ensemble(functools.partial(
+        louvain_single, max_sweeps=min(warm_sweep_budget(), max_sweeps),
+        update_prob=update_prob, gamma=gamma))
+    return det
 
 
 louvain = make_louvain()

@@ -227,6 +227,18 @@ def pair_jitter(key: jax.Array, node: jax.Array, label: jax.Array,
     return (m >> 8).astype(jnp.float32) * (scale / jnp.float32(1 << 24))
 
 
+def gumbel_from_uniform(u: jax.Array) -> jax.Array:
+    """Standard Gumbel noise from uniform draws in [0, 1).
+
+    argmax(gain + theta * G) over candidates samples one with probability
+    proportional to exp(gain / theta) — the Gumbel-max reformulation of
+    leidenalg's theta-randomized merge distribution, usable inside the
+    existing per-candidate argmax machinery.
+    """
+    u = jnp.clip(u, 1e-7, 1.0 - 1e-7)
+    return -jnp.log(-jnp.log(u))
+
+
 def uniform_jitter(key: jax.Array, shape, scale: float = 1e-3) -> jax.Array:
     """Keyed tie-break noise, strictly inside [0, scale).
 

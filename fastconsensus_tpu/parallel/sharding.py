@@ -11,11 +11,18 @@ insert the collectives:
   the graph slab is replicated, and each chip runs its shard of the ensemble.
   Co-membership counting then contracts the ``n_p`` axis, which XLA lowers to
   one ``psum`` over ICI — the only communication in the whole round.
-* **edge axis ``"e"`` (the SP/TP analog)** — for graphs too large for one
-  chip's HBM the COO slab itself shards along capacity: per-node segment
-  reductions (degrees, neighbor votes, community statistics) become local
-  partial sums + ``psum``, again inserted by the partitioner from the
-  sharding annotations rather than hand-written collectives.
+* **edge axis ``"e"`` (the SP/TP analog)** — the COO slab itself shards
+  along capacity, distributing the *resident* graph across chips' HBM.
+  Measured caveat (round 2, 120k-edge HLO inspection on a p=4 x e=2 mesh):
+  XLA's partitioner keeps simple segment reductions sharded, but the
+  round's sort-based ops (CSR build for wedge sampling, insert-dedup
+  lexsort) need a global order and re-gather the slab — 19 capacity-sized
+  all-gathers per *round* (not per detection sweep; sweeps run on
+  per-detection layouts built once).  That is cheap through ~10^7 edges
+  (MBs per round) but means the edge axis does not yet reduce peak
+  *working* memory for the round step itself; sort-free reformulations of
+  closure/dedup are the known path to true edge-local compute
+  (tests/test_parallel.py pins today's behavior).
 
 No hand-rolled communication backend exists or is needed (the reference has
 none either): `jit` + `NamedSharding` over the mesh IS the distributed

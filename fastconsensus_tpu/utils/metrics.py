@@ -43,6 +43,50 @@ def nmi(labels_a, labels_b) -> float:
     return max(0.0, min(1.0, mi / denom))
 
 
+def map_equation(src, dst, weight, labels) -> float:
+    """Two-level map equation L(M) in bits (Rosvall–Bergstrom 2008).
+
+    For an undirected weighted graph with visit rates ``p_i =
+    strength_i / 2m`` and module exit rates ``q_m = w_cross(m) / 2m``:
+
+        L(M) = plogp(sum_m q_m) - 2 sum_m plogp(q_m)
+             + sum_m plogp(q_m + sum_{i in m} p_i) - sum_i plogp(p_i)
+
+    This is the quantity ``native/src/infomap.cpp`` minimizes (which drops
+    the partition-independent last term); implemented independently here so
+    tests can verify the native optimizer against hand-computed values
+    (VERDICT round 1 #8).  Self-loops must be passed once; they contribute
+    to strengths but never to exit rates.
+    """
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    w = np.asarray(weight, dtype=np.float64)
+    labels = np.asarray(labels)
+    m2 = 2.0 * w.sum()
+    if m2 == 0.0:
+        return 0.0
+
+    def plogp(x):
+        x = np.asarray(x, dtype=np.float64)
+        out = np.zeros_like(x)
+        nz = x > 0
+        out[nz] = x[nz] * np.log2(x[nz])
+        return out
+
+    n_comm = int(labels.max()) + 1
+    strength = np.zeros(labels.shape[0], dtype=np.float64)
+    np.add.at(strength, src, w)
+    np.add.at(strength, dst, w)
+    p_mod = np.zeros(n_comm, dtype=np.float64)
+    np.add.at(p_mod, labels, strength / m2)
+    q = np.zeros(n_comm, dtype=np.float64)
+    cross = labels[src] != labels[dst]
+    np.add.at(q, labels[src[cross]], w[cross] / m2)
+    np.add.at(q, labels[dst[cross]], w[cross] / m2)
+    return float(plogp(q.sum()).sum() - 2.0 * plogp(q).sum()
+                 + plogp(q + p_mod).sum() - plogp(strength / m2).sum())
+
+
 def modularity(src, dst, weight, labels) -> float:
     """Newman modularity of a partition of an undirected weighted graph.
 

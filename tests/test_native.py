@@ -57,6 +57,116 @@ def test_infomap_weighted_graph_respects_weights():
     assert nmi(lab[0], truth) > 0.9
 
 
+def _two_triangles():
+    """Two triangles bridged by one edge — small enough to hand-compute the
+    map equation.  For the triangle partition: q_A = q_B = 1/14,
+    p_A = p_B = 1/2, node visit rates {2,2,3,3,2,2}/14, giving
+    L = plogp(1/7) - 2*2*plogp(1/14) + 2*plogp(8/14) - [4*plogp(1/7)
+    + 2*plogp(3/14)] = 2.320731 bits (worked by hand, VERDICT #8)."""
+    edges = np.array([[0, 1], [0, 2], [1, 2], [3, 4], [3, 5], [4, 5],
+                      [2, 3]])
+    truth = np.array([0, 0, 0, 1, 1, 1])
+    return edges, truth
+
+
+def test_map_equation_hand_computed_fixture():
+    from fastconsensus_tpu.utils.metrics import map_equation
+
+    edges, truth = _two_triangles()
+    w = np.ones(edges.shape[0])
+    L = map_equation(edges[:, 0], edges[:, 1], w, truth)
+    assert abs(L - 2.320731) < 2e-3, L
+    # the partition-quality ordering the optimizer must respect
+    L_one = map_equation(edges[:, 0], edges[:, 1], w, np.zeros(6, int))
+    L_single = map_equation(edges[:, 0], edges[:, 1], w, np.arange(6))
+    L_bad = map_equation(edges[:, 0], edges[:, 1], w,
+                         np.array([0, 0, 1, 0, 1, 1]))
+    assert L < L_one < L_single
+    assert L < L_bad
+
+
+def test_infomap_minimizes_map_equation():
+    """The native optimizer's output must reach the hand-known optimum on
+    the fixture and beat trivial/perturbed partitions on a planted graph —
+    a deliberately sign-flipped delta-L in infomap.cpp fails this."""
+    from fastconsensus_tpu.utils.metrics import map_equation
+
+    edges, truth = _two_triangles()
+    lab = native.infomap_labels(edges[:, 0], edges[:, 1], None, 6,
+                                np.arange(3, dtype=np.uint64))
+    w = np.ones(edges.shape[0])
+    for row in lab:
+        assert nmi(row, truth) == 1.0, row
+        assert abs(map_equation(edges[:, 0], edges[:, 1], w, row)
+                   - 2.320731) < 2e-3
+
+    edges, truth = planted_partition(400, 8, 0.25, 0.01, seed=7)
+    w = np.ones(edges.shape[0])
+    lab = native.infomap_labels(edges[:, 0], edges[:, 1], None, 400,
+                                np.arange(2, dtype=np.uint64))
+    L_opt = map_equation(edges[:, 0], edges[:, 1], w, lab[0])
+    rng = np.random.default_rng(0)
+    perturbed = lab[0].copy()
+    flip = rng.choice(400, 40, replace=False)
+    perturbed[flip] = rng.integers(0, perturbed.max() + 1, 40)
+    assert L_opt <= map_equation(edges[:, 0], edges[:, 1], w, truth) + 1e-6
+    assert L_opt < map_equation(edges[:, 0], edges[:, 1], w, perturbed)
+    assert L_opt < map_equation(edges[:, 0], edges[:, 1], w,
+                                np.zeros(400, int))
+
+
+def test_infomap_hard_mixing_regime():
+    """Near-detectability planted case (VERDICT #8: round 1 validated only
+    p_in/p_out = 30x regimes where any method succeeds).
+
+    Chosen at the measured map-equation detectability edge: at
+    p_in/p_out = 0.075/0.025 the one-module partition has LOWER L than the
+    planted truth (9.18 vs 9.55 bits) so collapse is *correct* there; at
+    0.09/0.02 truth wins (L 9.16) and the optimizer recovers it
+    (NMI 0.93-0.97 measured) — a collapse here is a real regression."""
+    edges, truth = planted_partition(600, 4, 0.09, 0.02, seed=13)
+    lab = native.infomap_labels(edges[:, 0], edges[:, 1], None, 600,
+                                np.arange(4, dtype=np.uint64))
+    scores = [nmi(row, truth) for row in lab]
+    assert max(scores) > 0.5, scores
+
+
+def test_cnm_weighted_heap_uses_weights():
+    """Weights must drive the merge heap: heavy bridges between triangles
+    flip the best partition relative to the unweighted graph."""
+    edges, _ = _two_triangles()
+    # bridge (2,3) heavy, triangle edges light: weighted modularity is
+    # maximized by grouping across the bridge
+    w = np.where((edges[:, 0] == 2) & (edges[:, 1] == 3), 10.0, 1.0)
+    lab_w = native.cnm_labels(edges[:, 0], edges[:, 1],
+                              w.astype(np.float32), 6,
+                              np.arange(2, dtype=np.uint64))
+    lab_u = native.cnm_labels(edges[:, 0], edges[:, 1], None, 6,
+                              np.arange(2, dtype=np.uint64))
+    q_w = modularity(edges[:, 0], edges[:, 1], w, lab_w[0])
+    q_u_on_w = modularity(edges[:, 0], edges[:, 1], w, lab_u[0])
+    assert lab_w[0][2] == lab_w[0][3], lab_w[0]  # heavy bridge co-clustered
+    assert q_w >= q_u_on_w - 1e-9, (q_w, q_u_on_w)
+
+
+def test_cnm_hub_heavy_graph():
+    """Hub-dominated graph exercises the lazy-invalidation heap: a hub
+    touching every community invalidates many pending merges."""
+    rng = np.random.default_rng(5)
+    edges, truth = planted_partition(400, 8, 0.3, 0.004, seed=9)
+    hub = 400  # one extra node wired to 200 random nodes
+    extra = np.stack([np.full(200, hub),
+                      rng.choice(400, 200, replace=False)], 1)
+    all_edges = np.vstack([edges, extra])
+    lab = native.cnm_labels(all_edges[:, 0], all_edges[:, 1], None, 401,
+                            np.arange(2, dtype=np.uint64))
+    for row in lab:
+        assert nmi(row[:400], truth) > 0.85
+        q = modularity(all_edges[:, 0], all_edges[:, 1],
+                       np.ones(all_edges.shape[0]), row)
+        assert q > 0.4, q
+
+
 def test_parser_matches_python_reader(tmp_path):
     p = tmp_path / "g.txt"
     p.write_text("# comment\n1 2\n2 3 0.5\n\n3 9\n")

@@ -78,3 +78,101 @@ def test_sharded_matches_unsharded_bitwise(karate_slab):
     assert base.rounds == sharded.rounds
     for a, b in zip(base.partitions, sharded.partitions):
         np.testing.assert_array_equal(a, b)
+
+
+def test_non_divisible_n_p_raises(karate_slab):
+    """Round 1 warned and silently ran unsharded; now it is an error
+    (device_put rejects uneven axes and GSPMD re-shards behind your back)."""
+    import pytest
+
+    mesh = parallel.make_mesh()  # p=8
+    cfg = ConsensusConfig(algorithm="lpm", n_p=10, tau=0.5, delta=0.1)
+    with pytest.raises(ValueError, match="not divisible"):
+        run_consensus(karate_slab, get_detector("lpm"), cfg, mesh=mesh)
+
+
+def _big_skewed_graph():
+    from fastconsensus_tpu.graph import pack_edges
+    from fastconsensus_tpu.utils.synth import planted_partition
+
+    edges, truth = planted_partition(20_000, 40, 0.025, 0.0002, seed=1)
+    assert edges.shape[0] >= 100_000, edges.shape  # the design-scale regime
+    return pack_edges(edges, 20_000), truth
+
+
+def test_edge_sharded_parity_at_scale():
+    """VERDICT #4: a >=100k-edge graph on a 2D (p=4, e=2) mesh must match
+    the unsharded run bitwise (1 full round + final detection)."""
+    slab, _ = _big_skewed_graph()
+    det = get_detector("lpm")
+    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.02,
+                          max_rounds=1, seed=2)
+    base = run_consensus(slab, det, cfg)
+    mesh = parallel.make_mesh(ensemble=4, edge=2)
+    sharded = run_consensus(slab, det, cfg, mesh=mesh)
+    assert base.rounds == sharded.rounds
+    np.testing.assert_array_equal(
+        np.asarray(base.graph.alive),
+        np.asarray(sharded.graph.alive)[:base.graph.capacity])
+    for a, b in zip(base.partitions, sharded.partitions):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_edge_sharding_hlo_behavior_pinned():
+    """Pin the measured partitioning behavior of the round step on a 2D
+    mesh (sharding.py module docstring): outputs keep their annotated
+    shardings, and slab-sized all-gathers stay a per-round constant (the
+    sort-based ops re-gather; detection sweeps must not add per-sweep
+    gathers on top)."""
+    import functools
+    import re
+
+    import jax
+
+    from fastconsensus_tpu.consensus import consensus_round
+
+    slab, _ = _big_skewed_graph()
+    mesh = parallel.make_mesh(ensemble=4, edge=2)
+    sl = parallel.shard_slab(slab, mesh)
+    step = jax.jit(functools.partial(
+        consensus_round, detect=get_detector("lpm"), n_p=8, tau=0.5,
+        delta=0.02, n_closure=int(slab.num_alive()),
+        ensemble_sharding=parallel.keys_sharding(mesh)))
+    comp = step.lower(sl, jax.random.key(0)).compile()
+    new_slab, labels, _ = step(sl, jax.random.key(0))
+    assert new_slab.src.sharding.is_equivalent_to(
+        parallel.slab_sharding(mesh), ndim=1)
+    assert labels.sharding.is_equivalent_to(
+        parallel.labels_sharding(mesh), ndim=2)
+    gathers = re.findall(r"all-gather[^\n]*", comp.as_text())
+    cap = sl.capacity
+    slab_sized = [g for g in gathers
+                  if re.search(rf"\[{cap}\]|\[{2 * cap}\]", g)]
+    # measured 19 at the time of pinning; headroom to 30 so benign XLA
+    # version drift does not flake, while a per-sweep regression (x32
+    # sweeps) still fails loudly
+    assert len(slab_sized) <= 30, len(slab_sized)
+
+
+def test_detect_cache_recovery_under_mesh(tmp_path, monkeypatch):
+    """Split-phase detection + chunk cache must work under a mesh (round 1
+    disabled it there — VERDICT #4); cached chunks are read back on retry
+    and reproduce the identical result."""
+    from fastconsensus_tpu.utils.synth import planted_partition
+    from fastconsensus_tpu.graph import pack_edges
+
+    edges, _ = planted_partition(300, 6, 0.3, 0.02, seed=4)
+    slab = pack_edges(edges, 300)
+    det = get_detector("lpm")
+    mesh = parallel.make_mesh()  # p=8
+    monkeypatch.setenv("FCTPU_DETECT_CALL_MEMBERS", "8")
+    cfg = ConsensusConfig(algorithm="lpm", n_p=16, tau=0.5, delta=0.02,
+                          max_rounds=3, seed=5)
+    d = str(tmp_path / "cache")
+    first = run_consensus(slab, det, cfg, mesh=mesh, detect_cache_dir=d)
+    files = sorted(p.name for p in (tmp_path / "cache").iterdir())
+    assert files, "no detect chunks persisted under the mesh"
+    second = run_consensus(slab, det, cfg, mesh=mesh, detect_cache_dir=d)
+    assert first.rounds == second.rounds
+    for a, b in zip(first.partitions, second.partitions):
+        np.testing.assert_array_equal(a, b)
