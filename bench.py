@@ -63,11 +63,14 @@ CONFIGS = {
     "emailEu": dict(kind="planted", n=1005, n_comm=42, p_in=0.6,
                     p_out=0.02, size_alpha=0.85, n_p=50, tau=0.8,
                     delta=0.02, alg="lpm"),
-    # eval config 5 analog (stress; SBM sampler, LFR generation at 100k is
-    # too slow to run inside the bench)
+    # eval config 5 (stress).  LFR generation at 100k is too slow to run
+    # inside the bench; when a cached real-LFR edgelist exists (generate
+    # once with utils.synth.lfr_graph and save npz {edges, labels} at the
+    # path below) it is used, else the SBM sampler stands in.
     "planted100k": dict(kind="planted", n=100_000, n_comm=200, p_in=0.04,
                         p_out=0.0002, n_p=200, tau=0.2, delta=0.02,
-                        alg="louvain", max_rounds=8),
+                        alg="louvain", max_rounds=8,
+                        lfr_file="bench_data/lfr100k.npz"),
 }
 
 # Zachary karate club two-faction ground truth (Zachary 1977).
@@ -76,18 +79,28 @@ KARATE_FACTIONS = [0, 0, 0, 0, 0, 0, 0, 0, 1, 1, 0, 0, 0, 0, 1, 1, 0, 0,
 
 
 def make_graph(cfg, seed=42):
+    """Returns (edges, truth, variant) where variant tags the graph source
+    ("" = as configured, "lfr" = the cached real-LFR file was loaded) —
+    the tag keys the CPU-baseline cache so an SBM baseline is never
+    compared against a real-LFR accelerator run."""
     import numpy as np
 
     from fastconsensus_tpu.utils import synth
 
+    if cfg.get("lfr_file"):
+        path = os.path.join(REPO, cfg["lfr_file"])
+        if os.path.exists(path):
+            z = np.load(path)
+            return z["edges"], z["labels"], "lfr"
     if cfg["kind"] == "karate":
         from fastconsensus_tpu.utils.io import read_edgelist
 
         edges, _, _ = read_edgelist(
             os.path.join(REPO, "examples", "karate_club.txt"))
-        return edges, np.array(KARATE_FACTIONS)
+        return edges, np.array(KARATE_FACTIONS), ""
     if cfg["kind"] == "lfr":
-        return synth.lfr_graph(cfg["n"], cfg["mu"], seed=seed)
+        edges, labels = synth.lfr_graph(cfg["n"], cfg["mu"], seed=seed)
+        return edges, labels, ""
     sizes = None
     if cfg.get("size_alpha"):
         # heterogeneous block sizes ~ rank^-alpha (email-Eu-core-like)
@@ -97,8 +110,10 @@ def make_graph(cfg, seed=42):
             sizes[np.argmax(sizes)] -= 1
         while sizes.sum() < cfg["n"]:
             sizes[np.argmin(sizes)] += 1
-    return synth.planted_partition(cfg["n"], cfg["n_comm"], cfg["p_in"],
-                                   cfg["p_out"], seed=seed, sizes=sizes)
+    edges, labels = synth.planted_partition(cfg["n"], cfg["n_comm"],
+                                            cfg["p_in"], cfg["p_out"],
+                                            seed=seed, sizes=sizes)
+    return edges, labels, ""
 
 
 def measure_baseline(name, cfg, edges, n_nodes, truth):
@@ -142,7 +157,9 @@ def measure_baseline(name, cfg, edges, n_nodes, truth):
 def main() -> int:
     name = os.environ.get("FCTPU_BENCH_CONFIG", "lfr1k")
     cfg = CONFIGS[name]
-    edges, truth = make_graph(cfg)
+    edges, truth, variant = make_graph(cfg)
+    if variant:
+        name = f"{name}_{variant}"
     n_nodes = int(truth.shape[0])
 
     baseline = measure_baseline(name, cfg, edges, n_nodes, truth)

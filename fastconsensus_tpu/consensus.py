@@ -86,6 +86,9 @@ class RoundStats(NamedTuple):
     n_dropped: jax.Array       # int32[] survivors dropped for capacity
     n_overflow: jax.Array      # int32[] directed edges beyond d_cap, i.e.
                                # dropped from dense move-candidate rows
+    n_hub_overflow: jax.Array  # int32[] hub directed edges beyond hub_cap,
+                               # i.e. dropped from the hybrid path's hashed
+                               # move candidates (ops/dense_adj.build_hybrid)
 
 
 def consensus_tail(slab: GraphSlab,
@@ -128,6 +131,19 @@ def consensus_tail(slab: GraphSlab,
             jnp.maximum(slab.degrees() - slab.d_cap, 0).astype(jnp.int32))
     else:
         n_overflow = jnp.int32(0)
+    from fastconsensus_tpu.models.louvain import select_move_path
+    if select_move_path(slab) == "hybrid":
+        # same count build_hybrid would drop next round: total degree of
+        # hub nodes beyond the static prefix budget (ADVICE round 2 —
+        # consensus rounds can outgrow the pack-time hub_cap silently).
+        # Gated on the *selected* path: slabs can carry hybrid sizing yet
+        # take the matmul/dense path, where nothing is ever dropped.
+        deg = slab.degrees()
+        hub_mass = jnp.sum(jnp.where(deg > slab.d_hyb, deg, 0)
+                           .astype(jnp.int32))
+        n_hub_overflow = jnp.maximum(hub_mass - slab.hub_cap, 0)
+    else:
+        n_hub_overflow = jnp.int32(0)
     stats = RoundStats(
         converged=st_mid.converged | st_end.converged,
         n_alive=st_end.n_alive,
@@ -136,6 +152,7 @@ def consensus_tail(slab: GraphSlab,
         n_repaired=n_repaired,
         n_dropped=n_dropped,
         n_overflow=n_overflow,
+        n_hub_overflow=n_hub_overflow,
     )
     return slab, stats
 
@@ -253,7 +270,7 @@ def consensus_rounds_block(slab: GraphSlab,
         z = jnp.zeros((block,), jnp.int32)
         return RoundStats(converged=jnp.zeros((block,), bool), n_alive=z,
                           n_unconverged=z, n_closure_added=z, n_repaired=z,
-                          n_dropped=z, n_overflow=z)
+                          n_dropped=z, n_overflow=z, n_hub_overflow=z)
 
     def cond(carry):
         _, i, conv, _, _ = carry
@@ -306,7 +323,8 @@ def _jitted_tail(n_p: int, tau: float, delta: float, n_closure: int):
 
 def _members_per_call(slab: GraphSlab, n_p: int,
                       detect: Optional[Detector] = None,
-                      measured_s: Optional[float] = None) -> int:
+                      measured_s: Optional[float] = None,
+                      alg: Optional[str] = None) -> int:
     """How many ensemble members one detection device-call should carry.
 
     A single XLA execution must stay well under the TPU tunnel's ~60 s
@@ -317,38 +335,94 @@ def _members_per_call(slab: GraphSlab, n_p: int,
     Per-member time: ``measured_s`` — the actual on-device rate from this
     run's own detection calls (run_consensus feeds it back after every
     round and persists it in checkpoints, so resumes re-derive identical
-    chunking) — or, before anything has been measured, the
-    :func:`_est_member_seconds` prior (sweep-temporary bytes x the
-    hardware-calibrated ``_NS_PER_TEMP_BYTE`` table, scaled by the
-    detector's ``cost_mult`` hint for multi-phase detectors like leiden).
-    FCTPU_DETECT_CALL_MEMBERS overrides everything (<= 0 disables
-    splitting).
+    chunking) — or, before anything has been measured in this process, the
+    :func:`_est_member_seconds` prior (a rate previously measured on this
+    backend if one is persisted — utils/calibrate.py — else the hardcoded
+    ``_NS_PER_TEMP_BYTE`` table).  FCTPU_DETECT_CALL_MEMBERS overrides
+    everything (<= 0 disables splitting).
     """
     c = env_int("FCTPU_DETECT_CALL_MEMBERS")
     if c is not None:
         return n_p if c <= 0 else min(c, n_p)
-    per = measured_s if measured_s else \
-        _est_member_seconds(slab) * getattr(detect, "cost_mult", 1.0)
+    per = measured_s if measured_s else _est_member_seconds(slab, detect, alg)
     return max(1, min(n_p, int(15.0 / max(per, 1e-9))))
 
 
-# Measured effective cost per byte of per-sweep temporaries, by move path
-# (TPU v5e via the dev tunnel): the matmul path streams (MXU/HBM-bound),
-# dense pays the row sort / pallas compare, hash and runs are
-# scatter/sort-bound; hybrid sits between dense and hash (narrow rows +
+# Never-measured prior: effective cost per byte of per-sweep temporaries,
+# by move path (TPU v5e via the dev tunnel): the matmul path streams
+# (MXU/HBM-bound), dense pays the row sort / pallas compare, hash and runs
+# are scatter/sort-bound; hybrid sits between dense and hash (narrow rows +
 # small scatters).  Calibrated against lfr1k (matmul), planted-100k
-# (dense) and lfr10k (hash/hybrid) detections.
+# (dense) and lfr10k (hash/hybrid) detections.  Once a run has measured a
+# real rate on a backend it is persisted and preferred
+# (utils/calibrate.py), so this table is load-bearing only for the very
+# first run on fresh hardware.
 _NS_PER_TEMP_BYTE = {"matmul": 0.02, "dense": 0.2, "hybrid": 0.3,
                      "hash": 0.8, "runs": 1.5}
 
+# Shortest device call whose wall time is persisted as a calibration rate
+# (run_consensus.record_rate): below this, host-device dispatch/readback
+# latency dominates and the derived ns/byte would be garbage.
+_MIN_PERSIST_CALL_S = 2.0
 
-def _est_member_seconds(slab: GraphSlab) -> float:
-    """Crude per-ensemble-member detection time estimate for call sizing."""
+
+def _member_temp_bytes(slab: GraphSlab) -> int:
+    """The denominator of the ns-per-byte rate unit — shared by the
+    estimator and the recorder (record_rate), and baked into persisted
+    calibration files: both sides MUST use this one definition or every
+    stored rate silently mis-scales."""
     from fastconsensus_tpu.models import louvain
 
+    return 96 * louvain.sweep_temp_bytes(slab)
+
+
+def _est_member_seconds(slab: GraphSlab,
+                        detect: Optional[Detector] = None,
+                        alg: Optional[str] = None) -> float:
+    """Per-ensemble-member detection time estimate for call sizing.
+
+    Prefers a rate measured on this backend by an earlier run (persisted —
+    utils/calibrate.py; it embodies the detector's full per-member cost).
+    Falls back to the ``_NS_PER_TEMP_BYTE`` prior scaled by the detector's
+    ``cost_mult`` hint (multi-phase detectors like leiden).
+    """
+    from fastconsensus_tpu.models import louvain
+    from fastconsensus_tpu.utils import calibrate
+
     path = louvain.select_move_path(slab)
-    return (96 * louvain.sweep_temp_bytes(slab)
-            * _NS_PER_TEMP_BYTE[path] * 1e-9)
+    temp_bytes = _member_temp_bytes(slab)
+    if alg is not None:
+        rate = calibrate.get_rate(jax.default_backend(), path, alg)
+        if rate is not None:
+            return temp_bytes * rate * 1e-9
+    mult = getattr(detect, "cost_mult", 1.0) if detect is not None else 1.0
+    return temp_bytes * _NS_PER_TEMP_BYTE[path] * 1e-9 * mult
+
+
+def _read_sizing(cache_dir: str) -> Optional[dict]:
+    """The detect-call sizing a previous process used with this chunk-cache
+    dir (see setup_executables: restart must reuse the killed run's
+    chunking or every persisted chunk of the round is orphaned)."""
+    import json
+
+    try:
+        with open(os.path.join(cache_dir, "sizing.json")) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _write_sizing(cache_dir: str, fp: str, members: int) -> None:
+    import json
+    import tempfile
+
+    try:
+        fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".tmp")
+        with os.fdopen(fd, "w") as fh:
+            json.dump({"fp": fp, "members": members}, fh)
+        os.replace(tmp, os.path.join(cache_dir, "sizing.json"))
+    except OSError as e:  # read-only/full dir: sizing is an optimization
+        _logger.debug("detect-call sizing not persisted: %s", e)
 
 
 def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
@@ -405,6 +479,7 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
         if init_labels is not None:
             init_labels = init_labels[idx]
     parts = []
+    computed = 0  # chunks actually executed (not cache-loaded) this call
     for i in range(n_calls):
         path = None
         if cache_dir:
@@ -430,10 +505,13 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
         dt = time.perf_counter() - t0
         _logger.debug("detect call %d/%d (%d members): %.1fs",
                       i + 1, n_calls, members, dt)
-        if timings is not None and i > 0:
-            # call 0 of a new shape pays the compile; later calls measure
-            # the pure execute rate (the quantity call sizing needs)
+        if timings is not None and computed > 0:
+            # the first *executed* chunk of a new shape pays the compile
+            # (on a cache-assisted restart that may be chunk k, not chunk
+            # 0); later executions measure the pure execute rate (the
+            # quantity call sizing needs)
             timings.append(dt / members)
+        computed += 1
         if path is not None:
             tmp = path + ".tmp"
             with open(tmp, "wb") as fh:  # np.save would append .npy to tmp
@@ -510,8 +588,20 @@ def run_consensus(slab: GraphSlab,
         from fastconsensus_tpu.utils import checkpoint as ckpt
 
         in_nodes, in_cap = slab.n_nodes, slab.capacity
+        in_hyb, in_hub = slab.d_hyb, slab.hub_cap
         slab, start_round, key_data, prior_history, extra = \
             ckpt.load_checkpoint(checkpoint_path)
+        if extra.pop("_legacy_v1", False) and (in_hyb or in_hub):
+            # v1 checkpoints predate hybrid sizing in the metadata; loading
+            # them with d_hyb=0 would flip select_move_path hybrid -> hash
+            # on resume (different lowering => different labels).  The
+            # sizing is a deterministic function of the input degree
+            # histogram, so the caller's freshly packed slab carries the
+            # original run's exact values — inherit them.
+            _logger.info(
+                "migrating v1 checkpoint: restoring hybrid sizing "
+                "d_hyb=%d hub_cap=%d from the input pack", in_hyb, in_hub)
+            slab = dataclasses.replace(slab, d_hyb=in_hyb, hub_cap=in_hub)
         if warm and extra.get("_labels") is not None:
             cur_labels = jnp.asarray(extra["_labels"])
         measured_member_s = extra.get("member_seconds") or None
@@ -573,72 +663,164 @@ def run_consensus(slab: GraphSlab,
     cache_fp = ""
     split_phase = False
     fused_block = 1
-    block_fn = round_fn = tail_fn = None
+    block_fn = round_fn = None
+
+    def mesh_rounded(m: int) -> int:
+        """Round a per-call member count up to tile the mesh ensemble axis:
+        chunked detection under a mesh must device_put whole-axis chunks
+        (round 1 disabled split-phase — and with it mid-round elastic
+        recovery — on exactly the long multi-chip runs that need it most,
+        VERDICT #4/#6)."""
+        if ensemble_sharding is None or m >= config.n_p:
+            return m
+        from fastconsensus_tpu.parallel import sharding as shard
+
+        p_axis = mesh.shape[shard.ENSEMBLE_AXIS]
+        return min(config.n_p, -(-m // p_axis) * p_axis)
+
+    def derive_sizing(force_members: Optional[int] = None
+                      ) -> Tuple[int, bool, int]:
+        """(members, split_phase, fused_block) from the current slab and the
+        best per-member rate known (this run's measurement, a persisted
+        backend calibration, or the static prior — in that order).
+        ``force_members`` pins the member count (chunk-cache adoption)."""
+        m = force_members if force_members is not None else mesh_rounded(
+            _members_per_call(
+                slab, config.n_p, detect, measured_s=measured_member_s,
+                alg=config.algorithm))
+        sp = m < config.n_p
+        # Fused-rounds mode: when a whole round is cheap (small graphs, no
+        # sharded mesh, no per-round checkpointing), run blocks of rounds
+        # in a single device call — the per-round dispatch + stats-readback
+        # latency through the TPU tunnel otherwise dominates the driver
+        # loop.  Block size targets ~15 s per call; 1 disables fusion.
+        fb = 1
+        if not sp and checkpoint_path is None and mesh is None:
+            round_s = (measured_member_s * config.n_p
+                       if measured_member_s else
+                       _est_member_seconds(slab, detect, config.algorithm)
+                       * config.n_p)
+            fb = max(1, min(8, int(15.0 / max(round_s, 1e-9))))
+        return m, sp, fb
 
     def setup_executables() -> None:
         """(Re-)derive call sizing and jitted step functions from the
         current slab.  Rerun after auto-growth — capacity is part of the
         compiled shapes, so growth costs one recompile here."""
         nonlocal members, cache_fp, split_phase, fused_block
-        nonlocal block_fn, round_fn, tail_fn
+        nonlocal block_fn, seen_execs, first_setup
         # Sized AFTER checkpoint resume: the loaded slab's d_cap can differ
         # from the caller's repack (the resume check matches
         # n_nodes/capacity only), and d_cap drives the move-path/time
         # estimate.  shard_slab only pads capacity by < mesh_edge_axis
         # entries, so the estimate carries over to the sharded slab.
-        members = _members_per_call(slab, config.n_p, detect,
-                                    measured_s=measured_member_s)
-        if ensemble_sharding is not None and members < config.n_p:
-            # chunked detection under a mesh: chunk sizes must tile the
-            # ensemble axis (round 1 disabled split-phase — and with it
-            # mid-round elastic recovery — on exactly the long multi-chip
-            # runs that need it most, VERDICT #4/#6)
-            from fastconsensus_tpu.parallel import sharding as shard
-
-            p_axis = mesh.shape[shard.ENSEMBLE_AXIS]
-            members = min(config.n_p, -(-members // p_axis) * p_axis)
-        cache_fp = ""
+        fp_base = ""
         if detect_cache_dir:
             import hashlib
 
             os.makedirs(detect_cache_dir, exist_ok=True)
-            # members is part of the fingerprint: a retry with a different
-            # chunking (the natural response to tunnel trouble) must not
-            # load mis-sized chunks; max_rounds guards the `_final` tag (a
-            # capped run's final detection is of a different consensus
-            # graph); gamma (detector hyper-parameter) guards rerunning
-            # with a different -g against the same dir — shape checks
-            # cannot catch that.  Live capacity is deliberately absent:
-            # labels are capacity-independent (louvain._cap_hint), so
-            # auto-growth must not retire a round's already-detected
-            # chunks; cap_hint covers the pack-time sizing instead.
-            cache_fp = hashlib.sha1(repr(
+            # The fingerprint guards mixing runs: max_rounds guards the
+            # `_final` tag (a capped run's final detection is of a
+            # different consensus graph); gamma (detector hyper-parameter)
+            # guards rerunning with a different -g against the same dir —
+            # shape checks cannot catch that.  Live capacity is
+            # deliberately absent: labels are capacity-independent
+            # (louvain._cap_hint), so auto-growth must not retire a
+            # round's already-detected chunks; cap_hint covers the
+            # pack-time sizing instead.  The mesh shape IS included: an
+            # adopted member count must tile the current ensemble axis.
+            fp_base = hashlib.sha1(repr(
                 (config.algorithm, config.n_p, config.tau, config.delta,
                  config.seed, config.max_rounds, slab.n_nodes,
-                 slab.cap_hint or slab.capacity, members, config.gamma,
-                 warm)
+                 slab.cap_hint or slab.capacity, config.gamma, warm,
+                 tuple(mesh.shape.items()) if mesh is not None else None)
             ).encode()).hexdigest()[:10]
-        split_phase = members < config.n_p
-        # Fused-rounds mode: when a whole round is cheap (small graphs, no
-        # sharded mesh, no per-round checkpointing), run blocks of rounds
-        # in a single device call — the per-round dispatch + stats-readback
-        # latency through the TPU tunnel otherwise dominates the driver
-        # loop.  Block size targets ~15 s per call; 1 disables fusion.
-        est_round_s = _est_member_seconds(slab) * \
-            getattr(detect, "cost_mult", 1.0) * config.n_p
-        fused_block = 1
-        if not split_phase and checkpoint_path is None and mesh is None:
-            fused_block = max(1, min(8, int(15.0 / max(est_round_s, 1e-9))))
-        block_fn = tail_fn = None
+        forced = None
+        if fp_base and first_setup and \
+                env_int("FCTPU_DETECT_CALL_MEMBERS") is None:
+            # A restarted process must reuse the killed run's chunking even
+            # though first-call sizing consults the mutable calibration
+            # file (utils/calibrate.py — possibly written by the killed
+            # run itself) or a checkpointed rate older than the in-flight
+            # round's chunks (checkpoint_every > 1): a different member
+            # count changes cache_fp and would orphan every
+            # already-persisted chunk of the round.  The sizing actually
+            # used is persisted next to the chunks and adopted on the
+            # process's FIRST setup only — later setups exist to change
+            # sizing (growth, measured re-sizes) and overwrite the file.
+            prev = _read_sizing(detect_cache_dir)
+            if prev is not None and prev.get("fp") == fp_base:
+                forced = int(prev["members"])
+        members, split_phase, fused_block = derive_sizing(forced)
+        seen_execs = set()
+        cache_fp = ""
+        if fp_base:
+            # members is part of the chunk fingerprint: a retry with a
+            # different chunking (the natural response to tunnel trouble)
+            # must not load mis-sized chunks.
+            cache_fp = hashlib.sha1(repr(
+                (fp_base, members)).encode()).hexdigest()[:10]
+            _write_sizing(detect_cache_dir, fp_base, members)
+        first_setup = False
+        block_fn = None
         if fused_block > 1:
             block_fn = _jitted_rounds_block(
                 detect, detect_warm, config.n_p, config.tau, config.delta,
                 n_closure, fused_block, warm)
-        elif split_phase:
-            tail_fn = _jitted_tail(config.n_p, config.tau, config.delta,
-                                   n_closure)
 
+    # Executable identities that already ran at least once since the last
+    # setup: their next call is compile-free, so its wall time is an honest
+    # rate measurement.  Keyed by detector object (the warm variant is a
+    # DIFFERENT executable whose first call pays its own compile — round-3
+    # review) or the "block" sentinel.
+    seen_execs: set = set()
+    first_setup = True
     setup_executables()
+
+    def record_rate(member_s: float, cold: bool, call_s: float) -> None:
+        """Persist the measured per-member rate for this backend so later
+        processes size their *first* call from hardware truth
+        (utils/calibrate.py; round-2 VERDICT Weak #5).
+
+        ``call_s`` is the wall time of the device call the rate came from:
+        short calls are dominated by host-device dispatch/readback latency
+        (through the TPU tunnel a near-empty round still costs ~0.5 s) and
+        would poison the per-byte rate for every other config on the
+        backend, so they are not persisted.  In-run sizing still uses them
+        (measured_member_s) — there the latency is part of the real cost
+        of the call being sized.
+        """
+        if call_s < _MIN_PERSIST_CALL_S:
+            return
+        from fastconsensus_tpu.models import louvain
+        from fastconsensus_tpu.utils import calibrate
+
+        calibrate.update_rate(
+            jax.default_backend(), louvain.select_move_path(slab),
+            config.algorithm,
+            member_s / _member_temp_bytes(slab) * 1e9,
+            "cold" if cold else "warm")
+
+    def maybe_resize() -> None:
+        """Between-round re-sizing from measured rates.  Only ever called at
+        the top of a loop iteration — a mid-round setup_executables() nulls
+        the executables the round in flight still needs (round-2 ADVICE
+        high).  Hysteresis on the fused-block size: a recompile through the
+        TPU tunnel costs ~35-55 s, so only act when the current sizing is
+        unsafe (estimated call > 30 s — the tunnel kills ~60 s executes) or
+        leaves a >= 2x fusion win on the table."""
+        if measured_member_s is None:
+            return
+        m, sp, fb = derive_sizing()
+        unsafe = fused_block > 1 and \
+            measured_member_s * config.n_p * fused_block > 30.0
+        if (sp != split_phase) or (sp and m != members) or unsafe or \
+                fb >= 2 * fused_block or 2 * fb <= fused_block:
+            _logger.info(
+                "re-sizing executables from measured %.3fs/member: "
+                "members %d -> %d, fused block %d -> %d",
+                measured_member_s, members, m, fused_block, fb)
+            setup_executables()
 
     def detect_for_round(r0: int) -> Detector:
         """Full-sweep base detector for the singleton-start round; the
@@ -681,6 +863,7 @@ def run_consensus(slab: GraphSlab,
             "n_repaired": int(stats.n_repaired),
             "n_dropped": int(stats.n_dropped),
             "n_overflow": int(stats.n_overflow),
+            "n_hub_overflow": int(stats.n_hub_overflow),
             "capacity": slab.capacity,
         }
         history.append(entry)
@@ -707,14 +890,19 @@ def run_consensus(slab: GraphSlab,
             (config.n_p, slab.n_nodes))
     r = start_round
     while r < end_round:
+        maybe_resize()
         pre_slab = slab
         if fused_block > 1:
             labels0 = cur_labels if warm else jnp.zeros(
                 (config.n_p, slab.n_nodes), jnp.int32)
+            t0 = time.perf_counter()
             slab, done, buf, new_labels = block_fn(
                 slab, key, labels0, jnp.int32(r), jnp.int32(end_round - r))
             done = int(done)
             buf = jax.device_get(buf)
+            dt = time.perf_counter() - t0
+            first_call = "block" not in seen_execs
+            seen_execs.add("block")
             dropped = int(max((buf.n_dropped[i] for i in range(done)),
                               default=0))
             if config.auto_grow and dropped > 0:
@@ -722,6 +910,13 @@ def run_consensus(slab: GraphSlab,
                 # saturating one recompute identically (same keys)
                 grow_and_replay(pre_slab, dropped)
                 continue
+            if not first_call and done > 0:
+                # the first call of a fresh executable pays the compile;
+                # later blocks measure the true on-device round rate (warm
+                # rounds when warm-starting: any non-first block is past
+                # absolute round 0)
+                measured_member_s = dt / (done * config.n_p)
+                record_rate(measured_member_s, cold=not warm, call_s=dt)
             if warm:
                 cur_labels = new_labels
             for i in range(done):
@@ -747,17 +942,19 @@ def run_consensus(slab: GraphSlab,
                     timings=timings)
                 if timings:
                     # feed the measured on-device rate back into call
-                    # sizing for subsequent rounds (replaces the static
-                    # estimate after round 0; persisted below)
+                    # sizing (replaces the static estimate after round 0;
+                    # persisted in checkpoints below and per-backend via
+                    # record_rate).  Applied by maybe_resize at the TOP of
+                    # the next iteration, never here: a mid-round re-size
+                    # may turn split-phase off entirely and null the
+                    # executables this round still needs (ADVICE round 2).
                     measured_member_s = float(np.median(timings))
-                    if _members_per_call(
-                            slab, config.n_p, detect,
-                            measured_s=measured_member_s) != members:
-                        _logger.info(
-                            "re-sizing detection calls: measured "
-                            "%.2fs/member", measured_member_s)
-                        setup_executables()
-                slab, stats = tail_fn(slab, labels, k_closure)
+                    record_rate(measured_member_s,
+                                cold=not warm or r == cold_start_round,
+                                call_s=measured_member_s * members)
+                slab, stats = _jitted_tail(
+                    config.n_p, config.tau, config.delta, n_closure)(
+                    slab, labels, k_closure)
                 stats = jax.device_get(stats)
                 while config.auto_grow and int(stats.n_dropped) > 0:
                     # capacity only matters after detection: replay just
@@ -773,9 +970,11 @@ def run_consensus(slab: GraphSlab,
                 if warm:
                     cur_labels = labels
             else:
+                round_detect = detect_for_round(r)
                 round_fn = _jitted_round(  # lru-cached: cheap per round
-                    detect_for_round(r), config.n_p, config.tau,
+                    round_detect, config.n_p, config.tau,
                     config.delta, n_closure, ensemble_sharding)
+                t0 = time.perf_counter()
                 if warm:
                     slab_new, new_labels, stats = round_fn(
                         slab, k, init_labels=cur_labels)
@@ -787,9 +986,21 @@ def run_consensus(slab: GraphSlab,
                 # round-trip latency, which through the TPU tunnel dwarfs
                 # the round's compute (measured).
                 stats = jax.device_get(stats)
+                dt = time.perf_counter() - t0
+                # The round-0 cold detector and the warm variant are
+                # DIFFERENT executables: each one's first call pays its own
+                # compile and must not be recorded as a rate.
+                first_call = round_detect not in seen_execs
+                seen_execs.add(round_detect)
                 if config.auto_grow and int(stats.n_dropped) > 0:
                     grow_and_replay(pre_slab, int(stats.n_dropped))
                     continue
+                if not first_call:
+                    # compile-free round: the whole-round wall time over
+                    # n_p approximates the per-member rate (tail included
+                    # — detection dominates at every measured config)
+                    measured_member_s = dt / config.n_p
+                    record_rate(measured_member_s, cold=not warm, call_s=dt)
                 if warm:
                     cur_labels = new_labels
             r += 1

@@ -19,6 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/fctpu_jax_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
+# No persisted rate calibration under test (utils/calibrate.py): rates
+# written by one test run would re-size detection calls in the next,
+# coupling outcomes across runs.  Tests that exercise calibration set
+# FCTPU_CALIBRATE_DIR to a tmp dir and re-enable explicitly.
+os.environ["FCTPU_CALIBRATE"] = "0"
+
 # The TPU-tunnel plugin registers itself from sitecustomize at interpreter
 # start (before this file runs) and hijacks backend selection even under
 # JAX_PLATFORMS=cpu; drop its factory so the suite can never touch (or hang

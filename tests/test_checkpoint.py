@@ -63,6 +63,100 @@ def test_resume_matches_uninterrupted_run(tmp_path):
         assert np.array_equal(a, b)
 
 
+def _hub_slab():
+    """Skewed-degree graph above MATMUL_MAX_N nodes that packs with hybrid
+    sizing: a sparse SBM base plus three cross-graph hubs (max degree blows
+    the dense d_cap budget; p95 stays narrow, so d_hyb/hub_cap are set)."""
+    n = 1400
+    edges, _ = planted_partition(n, 8, 0.02, 0.001, seed=5)
+    rng = np.random.default_rng(5)
+    hubs = []
+    for h in range(3):
+        nbrs = rng.choice(n, size=900, replace=False)
+        nbrs = nbrs[nbrs != h]
+        hubs.append(np.stack([np.full(nbrs.size, h), nbrs], 1))
+    return pack_edges(np.vstack([edges] + hubs), n)
+
+
+def test_checkpoint_preserves_hybrid_sizing(tmp_path):
+    """Round-trip keeps d_hyb/hub_cap, so select_move_path cannot flip
+    hybrid -> hash on resume (round-2 VERDICT Weak #2)."""
+    from fastconsensus_tpu.models.louvain import select_move_path
+
+    slab = _hub_slab()
+    assert slab.d_hyb > 0 and slab.hub_cap > 0
+    assert select_move_path(slab) == "hybrid"
+    path = str(tmp_path / "state.npz")
+    key_data = np.asarray(jax.random.key_data(jax.random.key(1)))
+    save_checkpoint(path, slab, 1, key_data, [])
+    slab2 = load_checkpoint(path)[0]
+    assert (slab2.d_cap, slab2.cap_hint, slab2.d_hyb, slab2.hub_cap) == \
+        (slab.d_cap, slab.cap_hint, slab.d_hyb, slab.hub_cap)
+    assert select_move_path(slab2) == "hybrid"
+
+
+def test_hub_resume_parity(tmp_path):
+    """Resume on a hub-heavy slab matches the uninterrupted run bitwise AND
+    keeps the hybrid move path across the round-trip."""
+    from fastconsensus_tpu.models.louvain import select_move_path
+
+    slab = _hub_slab()
+    detect = get_detector("louvain")
+    cfg = ConsensusConfig(algorithm="louvain", n_p=4, tau=0.2, delta=0.02,
+                          max_rounds=2, seed=1)
+    full = run_consensus(slab, detect, cfg)
+
+    path = str(tmp_path / "ck.npz")
+    cfg1 = ConsensusConfig(algorithm="louvain", n_p=4, tau=0.2, delta=0.02,
+                           max_rounds=1, seed=1)
+    run_consensus(slab, detect, cfg1, checkpoint_path=path)
+    resumed = run_consensus(slab, detect, cfg, checkpoint_path=path,
+                            resume=True)
+
+    assert select_move_path(resumed.graph) == "hybrid"
+    assert resumed.rounds == full.rounds
+    assert np.array_equal(np.asarray(resumed.graph.alive),
+                          np.asarray(full.graph.alive))
+    assert np.allclose(np.asarray(resumed.graph.weight),
+                       np.asarray(full.graph.weight))
+    for a, b in zip(resumed.partitions, full.partitions):
+        assert np.array_equal(a, b)
+
+
+def test_legacy_v1_checkpoint_migrates_hybrid_sizing(tmp_path):
+    """A v1 checkpoint (no d_hyb/hub_cap in meta) is migrated on resume:
+    the driver re-derives the sizing from the caller's freshly packed slab
+    instead of silently dropping to the hash path."""
+    import json
+    import zipfile
+
+    slab = _hub_slab()
+    detect = get_detector("louvain")
+    path = str(tmp_path / "ck.npz")
+    cfg1 = ConsensusConfig(algorithm="louvain", n_p=4, tau=0.2, delta=0.02,
+                           max_rounds=1, seed=1)
+    run_consensus(slab, detect, cfg1, checkpoint_path=path)
+
+    # Rewrite the metadata blob as a version-1 checkpoint.
+    with np.load(path) as z:
+        arrays = {name: z[name].copy() for name in z.files}
+    meta = json.loads(bytes(arrays["meta"]).decode())
+    meta["version"] = 1
+    del meta["d_hyb"], meta["hub_cap"]
+    arrays["meta"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+    np.savez(path, **arrays)
+
+    loaded, _, _, _, extra = load_checkpoint(path)
+    assert extra.get("_legacy_v1") and loaded.d_hyb == 0
+
+    cfg = ConsensusConfig(algorithm="louvain", n_p=4, tau=0.2, delta=0.02,
+                          max_rounds=2, seed=1)
+    resumed = run_consensus(slab, detect, cfg, checkpoint_path=path,
+                            resume=True)
+    assert resumed.graph.d_hyb == slab.d_hyb
+    assert resumed.graph.hub_cap == slab.hub_cap
+
+
 def test_resume_rejects_mismatched_config(tmp_path):
     import pytest
 
