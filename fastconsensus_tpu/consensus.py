@@ -75,6 +75,24 @@ class ConsensusConfig:
     # libraries offer no warm path).  Ignored by detectors that do not
     # support initialization (native CNM/Infomap).
     warm_start: bool = True
+    # Endgame member alignment: once a round ends with an unconverged-edge
+    # fraction below this, subsequent detection rounds share ONE PRNG key
+    # across all ensemble members (instead of n_p independent keys).
+    # Tie-break jitter is content-keyed on (node, community-representative)
+    # — member-invariant, models/louvain._community_reps — so members
+    # facing the same modularity-degenerate choice break it identically,
+    # collapsing exactly the residual disagreements that otherwise grind
+    # for rounds (round-1: 5 rounds on planted-100k vs 1 for the
+    # near-deterministic CPU reference).  Only active with warm_start
+    # (aligned COLD members would be identical clones — a single run in
+    # disguise); the diversity that builds the consensus signal comes from
+    # the independent rounds before the threshold.  The final re-detection
+    # is never aligned, and the singleton-start round never aligns.  Fused
+    # round blocks re-derive the flag per round from their own stats, so
+    # fused and per-round execution stay bit-identical.  Detectors without
+    # content-keyed tie-breaks (supports_align unset: lpm, native
+    # cnm/infomap) ignore it.  0 disables.
+    align_frac: float = 0.25
 
 
 class RoundStats(NamedTuple):
@@ -157,6 +175,21 @@ def consensus_tail(slab: GraphSlab,
     return slab, stats
 
 
+def _maybe_align_keys(keys: jax.Array, align) -> jax.Array:
+    """Give every ensemble member member 0's key when ``align`` is true.
+
+    ``align`` may be a Python bool (static short-circuit) or a traced bool
+    scalar (both variants live in one executable — select on the raw key
+    data; typed PRNG key arrays have no jnp.where).
+    """
+    if isinstance(align, bool) and not align:
+        return keys
+    aligned = keys[jnp.zeros((keys.shape[0],), jnp.int32)]
+    return jax.random.wrap_key_data(
+        jnp.where(align, jax.random.key_data(aligned),
+                  jax.random.key_data(keys)))
+
+
 def consensus_round(slab: GraphSlab,
                     key: jax.Array,
                     detect: Detector,
@@ -165,7 +198,8 @@ def consensus_round(slab: GraphSlab,
                     delta: float,
                     n_closure: int,
                     ensemble_sharding=None,
-                    init_labels: Optional[jax.Array] = None
+                    init_labels: Optional[jax.Array] = None,
+                    align: bool = False
                     ) -> Tuple[GraphSlab, jax.Array, RoundStats]:
     """One full consensus round.  Jittable; all shapes static.
 
@@ -179,13 +213,18 @@ def consensus_round(slab: GraphSlab,
     partition from singletons every round (the driver threads this;
     None = from-scratch, the reference's only mode, fc:148).
 
+    ``align`` shares member 0's detection key with every member (endgame
+    tie-break alignment, ConsensusConfig.align_frac; requires warm
+    init_labels to keep members distinct).  May be a traced bool scalar —
+    flipping it never recompiles the round.
+
     ``ensemble_sharding`` (a ``NamedSharding`` with spec ``P("p")``) pins the
     per-partition keys and labels to the mesh's ensemble axis; XLA then runs
     each chip's shard of the ensemble locally and contracts the n_p axis of
     the co-membership count with one ``psum`` — the round's only collective.
     """
     k_detect, k_closure = jax.random.split(key)
-    keys = prng.partition_keys(k_detect, n_p)
+    keys = _maybe_align_keys(prng.partition_keys(k_detect, n_p), align)
     if ensemble_sharding is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -218,6 +257,7 @@ def _jitted_round(detect: Detector, n_p: int, tau: float, delta: float,
     fresh ``functools.partial`` per run would recompile every round step on
     every call (measured: ~18s/run on the TPU tunnel).  Detectors from the
     registry are module-level singletons, so they hash stably here.
+    ``align`` stays a call-time (traced) argument for the same reason.
     """
     return jax.jit(functools.partial(
         consensus_round, detect=detect, n_p=n_p, tau=tau, delta=delta,
@@ -234,6 +274,7 @@ def consensus_rounds_block(slab: GraphSlab,
                            labels0: jax.Array,
                            start_round: jax.Array,
                            max_iters: jax.Array,
+                           align0: jax.Array,
                            detect: Detector,
                            detect_warm: Detector,
                            n_p: int,
@@ -241,7 +282,8 @@ def consensus_rounds_block(slab: GraphSlab,
                            delta: float,
                            n_closure: int,
                            block: int,
-                           warm: bool
+                           warm: bool,
+                           align_frac: float = 0.0
                            ) -> Tuple[GraphSlab, jax.Array, RoundStats,
                                       jax.Array]:
     """Up to ``min(block, max_iters)`` consensus rounds in ONE device call.
@@ -265,6 +307,14 @@ def consensus_rounds_block(slab: GraphSlab,
     ``warm=False`` the carry still tracks labels (for the caller's next
     block / final detection) but detection always cold-starts via
     ``detect``.
+
+    ``align0`` (traced bool) is the endgame-alignment state entering the
+    block (ConsensusConfig.align_frac); each in-block round re-derives it
+    from its own stats, so fused and per-round execution stay bit-identical
+    — the contract above.  ``align_frac=0`` keeps alignment off (the
+    driver passes 0 for detectors without content-keyed tie-breaks).
+    In-block rounds past the first always start from real carried labels,
+    so alignment can never clone a singleton-start round.
     """
     def empty_stats():
         z = jnp.zeros((block,), jnp.int32)
@@ -273,46 +323,54 @@ def consensus_rounds_block(slab: GraphSlab,
                           n_dropped=z, n_overflow=z, n_hub_overflow=z)
 
     def cond(carry):
-        _, i, conv, _, _ = carry
+        _, i, conv, _, _, _ = carry
         return (~conv) & (i < block) & (i < max_iters)
 
     def body(carry):
-        slab, i, _, buf, labels = carry
+        slab, i, _, buf, labels, aligned = carry
         k = prng.stream(key, prng.STREAM_ROUND, start_round + i)
         if warm and detect_warm is not detect:
             def run(d):
                 def go(op):
-                    s, kk, lab = op
+                    s, kk, lab, al = op
                     return consensus_round(
                         s, kk, detect=d, n_p=n_p, tau=tau, delta=delta,
-                        n_closure=n_closure, init_labels=lab)
+                        n_closure=n_closure, init_labels=lab, align=al)
                 return go
 
             slab, labels, st = jax.lax.cond(
                 start_round + i == 0, run(detect), run(detect_warm),
-                (slab, k, labels))
+                (slab, k, labels, aligned))
         else:
             slab, labels, st = consensus_round(
                 slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
                 n_closure=n_closure,
-                init_labels=labels if warm else None)
+                init_labels=labels if warm else None,
+                align=aligned if warm else False)
         buf = jax.tree.map(lambda b, s: b.at[i].set(s), buf, st)
-        return slab, i + 1, st.converged, buf, labels
+        if warm and align_frac > 0:
+            aligned = st.n_unconverged.astype(jnp.float32) <= \
+                jnp.float32(align_frac) * \
+                jnp.maximum(st.n_alive, 1).astype(jnp.float32)
+        else:
+            aligned = jnp.bool_(False)
+        return slab, i + 1, st.converged, buf, labels, aligned
 
-    slab, done, _, buf, labels = jax.lax.while_loop(
+    slab, done, _, buf, labels, _ = jax.lax.while_loop(
         cond, body,
-        (slab, jnp.int32(0), jnp.bool_(False), empty_stats(), labels0))
+        (slab, jnp.int32(0), jnp.bool_(False), empty_stats(), labels0,
+         jnp.asarray(align0, bool)))
     return slab, done, buf, labels
 
 
 @functools.lru_cache(maxsize=128)
 def _jitted_rounds_block(detect: Detector, detect_warm: Detector, n_p: int,
                          tau: float, delta: float, n_closure: int,
-                         block: int, warm: bool):
+                         block: int, warm: bool, align_frac: float = 0.0):
     return jax.jit(functools.partial(
         consensus_rounds_block, detect=detect, detect_warm=detect_warm,
         n_p=n_p, tau=tau, delta=delta, n_closure=n_closure, block=block,
-        warm=warm))
+        warm=warm, align_frac=align_frac))
 
 
 @functools.lru_cache(maxsize=128)
@@ -413,16 +471,10 @@ def _read_sizing(cache_dir: str) -> Optional[dict]:
 
 
 def _write_sizing(cache_dir: str, fp: str, members: int) -> None:
-    import json
-    import tempfile
+    from fastconsensus_tpu.utils.calibrate import atomic_write_json
 
-    try:
-        fd, tmp = tempfile.mkstemp(dir=cache_dir, suffix=".tmp")
-        with os.fdopen(fd, "w") as fh:
-            json.dump({"fp": fp, "members": members}, fh)
-        os.replace(tmp, os.path.join(cache_dir, "sizing.json"))
-    except OSError as e:  # read-only/full dir: sizing is an optimization
-        _logger.debug("detect-call sizing not persisted: %s", e)
+    atomic_write_json(os.path.join(cache_dir, "sizing.json"),
+                      {"fp": fp, "members": members})
 
 
 def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
@@ -564,6 +616,11 @@ def run_consensus(slab: GraphSlab,
         key = jax.random.key(config.seed)
     n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
     warm = config.warm_start and getattr(detect, "supports_init", False)
+    # Endgame alignment only for detectors whose tie-breaks are
+    # content-keyed (louvain._community_reps): without that, sharing keys
+    # merely strips the ensemble's key diversity with no collapse mechanism
+    # (label-id-keyed jitter differs per member regardless of the key).
+    align_ok = getattr(detect, "supports_align", False)
     # Capped-sweep variant for warm rounds (louvain.warm_sweep_budget):
     # under the ensemble vmap the sweep loop runs to the slowest member, so
     # warm rounds must *bound* sweeps to realize the warm-start savings.
@@ -577,8 +634,14 @@ def run_consensus(slab: GraphSlab,
     # On-device call-rate measurement: None until the first chunked
     # detection round reports timings; persisted in checkpoints so a
     # resumed process derives the same chunking (and thus hits the same
-    # detect-cache files) as the run it resumes.
+    # detect-cache files) as the run it resumes.  measured_in_process
+    # distinguishes a rate THIS process measured from one restored out of a
+    # checkpoint: re-sizing may only act on the former — a checkpointed
+    # rate can be older than the in-flight round's persisted chunks
+    # (checkpoint_every > 1), and re-sizing from it would override the
+    # sizing.json adoption and orphan them (round-3 review).
     measured_member_s: Optional[float] = None
+    measured_in_process = False
 
     start_round = 0
     prior_history: List[dict] = []
@@ -611,10 +674,11 @@ def run_consensus(slab: GraphSlab,
         # (weights are co-membership counts out of the *saved* n_p).
         saved = {k: extra.get(k) for k in
                  ("algorithm", "n_p", "tau", "delta", "gamma",
-                  "warm_start")}
+                  "warm_start", "align_frac")}
         want = {"algorithm": config.algorithm, "n_p": config.n_p,
                 "tau": config.tau, "delta": config.delta,
-                "gamma": config.gamma, "warm_start": config.warm_start}
+                "gamma": config.gamma, "warm_start": config.warm_start,
+                "align_frac": config.align_frac}
         mismatch = {k: (saved[k], want[k]) for k in want
                     if saved[k] is not None and saved[k] != want[k]}
         if slab.n_nodes != in_nodes:
@@ -733,6 +797,7 @@ def run_consensus(slab: GraphSlab,
                 (config.algorithm, config.n_p, config.tau, config.delta,
                  config.seed, config.max_rounds, slab.n_nodes,
                  slab.cap_hint or slab.capacity, config.gamma, warm,
+                 config.align_frac,
                  tuple(mesh.shape.items()) if mesh is not None else None)
             ).encode()).hexdigest()[:10]
         forced = None
@@ -766,7 +831,8 @@ def run_consensus(slab: GraphSlab,
         if fused_block > 1:
             block_fn = _jitted_rounds_block(
                 detect, detect_warm, config.n_p, config.tau, config.delta,
-                n_closure, fused_block, warm)
+                n_closure, fused_block, warm,
+                config.align_frac if (warm and align_ok) else 0.0)
 
     # Executable identities that already ran at least once since the last
     # setup: their next call is compile-free, so its wall time is an honest
@@ -808,8 +874,9 @@ def run_consensus(slab: GraphSlab,
         high).  Hysteresis on the fused-block size: a recompile through the
         TPU tunnel costs ~35-55 s, so only act when the current sizing is
         unsafe (estimated call > 30 s — the tunnel kills ~60 s executes) or
-        leaves a >= 2x fusion win on the table."""
-        if measured_member_s is None:
+        leaves a >= 2x fusion win on the table.  Acts only on rates this
+        process measured itself (see measured_in_process above)."""
+        if not measured_in_process:
             return
         m, sp, fb = derive_sizing()
         unsafe = fused_block > 1 and \
@@ -828,6 +895,23 @@ def run_consensus(slab: GraphSlab,
         if not warm or r0 == cold_start_round:
             return detect
         return detect_warm
+
+    def align_now(r0: int) -> bool:
+        """Share one detection key across members in round ``r0``?  Engages
+        once the consensus is nearly there (ConsensusConfig.align_frac),
+        only under warm start, and never on the singleton-start round —
+        aligned members with identical (cold or singleton-fallback) inits
+        would be clones, degrading the consensus to a single run."""
+        if not (warm and align_ok and config.align_frac > 0 and history):
+            return False
+        if r0 == cold_start_round:
+            return False
+        h = history[-1]
+        # float32 on both sides: the in-block rule (consensus_rounds_block)
+        # evaluates this threshold in f32, and fused/per-round execution
+        # must agree bit-exactly at the boundary
+        return np.float32(h["n_unconverged"]) <= \
+            np.float32(config.align_frac) * np.float32(max(h["n_alive"], 1))
 
     def grow_and_replay(pre_slab: GraphSlab, dropped: int) -> None:
         """Self-sizing slab: grow from the *pre-round* state and let the
@@ -897,7 +981,8 @@ def run_consensus(slab: GraphSlab,
                 (config.n_p, slab.n_nodes), jnp.int32)
             t0 = time.perf_counter()
             slab, done, buf, new_labels = block_fn(
-                slab, key, labels0, jnp.int32(r), jnp.int32(end_round - r))
+                slab, key, labels0, jnp.int32(r), jnp.int32(end_round - r),
+                jnp.bool_(align_now(r)))
             done = int(done)
             buf = jax.device_get(buf)
             dt = time.perf_counter() - t0
@@ -916,6 +1001,7 @@ def run_consensus(slab: GraphSlab,
                 # rounds when warm-starting: any non-first block is past
                 # absolute round 0)
                 measured_member_s = dt / (done * config.n_p)
+                measured_in_process = True
                 record_rate(measured_member_s, cold=not warm, call_s=dt)
             if warm:
                 cur_labels = new_labels
@@ -932,6 +1018,11 @@ def run_consensus(slab: GraphSlab,
                 # one-call execution produce identical results
                 k_detect, k_closure = jax.random.split(k)
                 keys = prng.partition_keys(k_detect, config.n_p)
+                if align_now(r):
+                    # endgame alignment: every member draws member 0's key
+                    # (tie-break jitter is community-content-keyed, so
+                    # members still differ through their warm labels)
+                    keys = keys[jnp.zeros((config.n_p,), jnp.int32)]
                 timings: List[float] = []
                 labels = _detect_chunked(
                     detect_for_round(r), slab, keys, members,
@@ -949,6 +1040,7 @@ def run_consensus(slab: GraphSlab,
                     # may turn split-phase off entirely and null the
                     # executables this round still needs (ADVICE round 2).
                     measured_member_s = float(np.median(timings))
+                    measured_in_process = True
                     record_rate(measured_member_s,
                                 cold=not warm or r == cold_start_round,
                                 call_s=measured_member_s * members)
@@ -976,8 +1068,11 @@ def run_consensus(slab: GraphSlab,
                     config.delta, n_closure, ensemble_sharding)
                 t0 = time.perf_counter()
                 if warm:
+                    # align passed traced: flipping it mid-run reuses the
+                    # same executable (no endgame recompile)
                     slab_new, new_labels, stats = round_fn(
-                        slab, k, init_labels=cur_labels)
+                        slab, k, init_labels=cur_labels,
+                        align=jnp.bool_(align_now(r)))
                 else:
                     slab_new, new_labels, stats = round_fn(slab, k)
                 slab = slab_new
@@ -1000,6 +1095,7 @@ def run_consensus(slab: GraphSlab,
                     # n_p approximates the per-member rate (tail included
                     # — detection dominates at every measured config)
                     measured_member_s = dt / config.n_p
+                    measured_in_process = True
                     record_rate(measured_member_s, cold=not warm, call_s=dt)
                 if warm:
                     cur_labels = new_labels
@@ -1016,6 +1112,7 @@ def run_consensus(slab: GraphSlab,
                            "tau": config.tau, "delta": config.delta,
                            "gamma": config.gamma,
                            "warm_start": config.warm_start,
+                           "align_frac": config.align_frac,
                            "member_seconds": measured_member_s,
                            "converged": converged},
                     labels=(np.asarray(cur_labels) if warm else None))

@@ -112,6 +112,9 @@ def make_leiden(max_sweeps: int = 32, gamma: float = 1.0,
         leiden_single, max_sweeps=min(warm_sweep_budget(), max_sweeps),
         gamma=gamma, theta=0.0))
     det.warm_variant.cost_mult = 4.0
+    # all three phases run louvain's move machinery, whose tie-break jitter
+    # is content-keyed (louvain._community_reps) — see ConsensusConfig.align_frac
+    det.supports_align = True
     return det
 
 

@@ -64,6 +64,28 @@ MATMUL_MAX_N = 1024
 DENSE_OVER_HASH = 8
 
 
+def _community_reps(labels: jax.Array, n: int) -> jax.Array:
+    """Canonical representative (minimum member node id) per community id.
+
+    Tie-break jitter is keyed on ``(node, rep[candidate_label])`` instead of
+    the raw label id: label ids are arbitrary per ensemble member (each
+    member names communities differently), while the min-node-id
+    representative is identical across members whenever the community is
+    the same *node set*.  Within one member the mapping label -> rep is
+    injective over live labels, so the jitter distribution is unchanged —
+    but when the consensus driver shares one detection key across members
+    (ConsensusConfig.align_frac endgame), members facing the same
+    degenerate choice between the same candidate communities now draw the
+    same noise and break the tie the same way.  That collapses exactly the
+    modularity-degenerate disagreements that keep the last few percent of
+    consensus edges mid-weight for rounds (round-1 measurement: 5 rounds on
+    planted-100k where near-deterministic CPU louvain needs 1).
+    Unused label ids map to the sentinel ``n``.
+    """
+    return jnp.full((n,), n, jnp.int32).at[
+        jnp.clip(labels, 0, n - 1)].min(jnp.arange(n, dtype=jnp.int32))
+
+
 def _theta_score(gain: jax.Array, noise_u: jax.Array, valid: jax.Array,
                  theta: float, m2: jax.Array) -> jax.Array:
     """Candidate scores for theta-randomized refinement (Leiden).
@@ -126,16 +148,17 @@ def _move_step(slab: GraphSlab, labels: jax.Array, key: jax.Array,
     # gain of node i joining C (with i removed from its current community):
     # k_i_in(C) - k_i * (Sigma_tot(C) - [i in C] k_i) / 2m
     gain = runs.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    rep = _community_reps(labels, n)[jnp.clip(runs.label, 0, n - 1)]
     if theta > 0.0:
-        u = seg.pair_jitter(k_tie, runs.node, runs.label, 1.0)
+        u = seg.pair_jitter(k_tie, runs.node, rep, 1.0)
         score = _theta_score(gain, u, runs.valid & ~own, theta, m2)
         best, _, has_any = seg.argmax_label_per_node(
             runs.node, score, runs.label, runs.valid, n)
         return best, has_any & (best >= 0) & (best != labels)
     # pair-keyed: tie-breaks must not depend on run positions, which shift
-    # with slab capacity (segment.pair_jitter)
-    score = gain + seg.pair_jitter(k_tie, runs.node, runs.label,
-                                   _JITTER_REL / m2)
+    # with slab capacity (segment.pair_jitter); rep-keyed for cross-member
+    # alignment (_community_reps)
+    score = gain + seg.pair_jitter(k_tie, runs.node, rep, _JITTER_REL / m2)
 
     best, best_score, has_any = seg.argmax_label_per_node(
         runs.node, score, runs.label, runs.valid, n)
@@ -194,16 +217,19 @@ def _move_step_matmul(W: jax.Array, labels: jax.Array, key: jax.Array,
     k_i = strength[:, None]
     gain = s - gamma * k_i * (
         sigma_tot[None, :] - jnp.where(own, k_i, 0.0)) / m2
+    # column c = community id c; rep-keyed jitter (see _community_reps)
+    nodes = jnp.arange(n, dtype=jnp.int32)
+    rep_row = _community_reps(labels, n)[None, :]
     if theta > 0.0:
-        u = seg.uniform_jitter(k_tie, gain.shape, 1.0)
+        u = seg.pair_jitter(k_tie, nodes[:, None], rep_row, 1.0)
         score = _theta_score(gain, u, (s > 0) & ~own, theta, m2)
         best = jnp.argmax(score, axis=1).astype(jnp.int32)
         best_score = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0]
         has = jnp.isfinite(best_score)
         return jnp.where(has, best, labels), has & (best != labels)
     score = jnp.where((s > 0) | own,
-                      gain + seg.uniform_jitter(k_tie, gain.shape,
-                                                _JITTER_REL / m2),
+                      gain + seg.pair_jitter(k_tie, nodes[:, None], rep_row,
+                                             _JITTER_REL / m2),
                       -jnp.inf)
     best = jnp.argmax(score, axis=1).astype(jnp.int32)
     best_score = jnp.take_along_axis(score, best[:, None], axis=1)[:, 0]
@@ -260,16 +286,18 @@ def _move_step_hash(slab: GraphSlab, labels: jax.Array, key: jax.Array,
     sig = sigma_tot[jnp.clip(lab_dst, 0, n - 1)]
     own = lab_dst == labels[src_c]
     gain = tot - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    rep_dst = _community_reps(labels, n)[jnp.clip(lab_dst, 0, n - 1)]
     if theta > 0.0:
-        u = seg.pair_jitter(k_tie, srcd, lab_dst, 1.0)
+        u = seg.pair_jitter(k_tie, srcd, rep_dst, 1.0)
         score = _theta_score(gain, u, valid & ~own, theta, m2)
         best, _, has_any = seg.scatter_argmax_label(
             srcd, score, lab_dst, valid, n)
         return best, has_any & (best >= 0) & (best != labels)
     # pair-keyed jitter: position-independent, so slab growth cannot
-    # reorder tie-breaks (see segment.pair_jitter)
+    # reorder tie-breaks (segment.pair_jitter); rep-keyed for cross-member
+    # alignment (_community_reps)
     score = jnp.where(valid, gain + seg.pair_jitter(
-        k_tie, srcd, lab_dst, _JITTER_REL / m2), -jnp.inf)
+        k_tie, srcd, rep_dst, _JITTER_REL / m2), -jnp.inf)
     best, best_score, has_any = seg.scatter_argmax_label(
         srcd, score, lab_dst, valid, n)
 
@@ -309,12 +337,16 @@ def _move_step_hybrid(hyb: da.HybridAdj, slab: GraphSlab, labels: jax.Array,
     sig = sigma_tot[jnp.clip(tot.label, 0, n - 1)]
     own = tot.label == labels[:, None]
     gain = tot.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    reps = _community_reps(labels, n)
+    nodes = jnp.arange(n, dtype=jnp.int32)
+    rep_d = reps[jnp.clip(tot.label, 0, n - 1)]
     if theta > 0.0:
-        u = seg.uniform_jitter(k_dense, gain.shape, 1.0)
+        u = seg.pair_jitter(k_dense, nodes[:, None], rep_d, 1.0)
         score = _theta_score(gain, u, tot.is_head & ~own, theta, m2)
         best_d, want_d = da.best_candidate(tot, score, labels)
     else:
-        jitter = seg.uniform_jitter(k_dense, gain.shape, _JITTER_REL / m2)
+        jitter = seg.pair_jitter(k_dense, nodes[:, None], rep_d,
+                                 _JITTER_REL / m2)
         score = jnp.where(tot.is_head, gain + jitter, -jnp.inf)
         best_d, want_d = da.best_candidate(tot, score, labels)
         best_score_d = jnp.max(score, axis=1)
@@ -325,8 +357,8 @@ def _move_step_hybrid(hyb: da.HybridAdj, slab: GraphSlab, labels: jax.Array,
     # hub side — hashed aggregation over the compacted prefix; synthetic
     # zero-weight stay entries for hub nodes (same invariant as
     # _move_step_hash: every looked-up pair must be inserted)
-    nodes = jnp.arange(n, dtype=jnp.int32)
     lab_hdst = labels[jnp.clip(hyb.hdst, 0, n - 1)]
+    rep_h = reps[jnp.clip(lab_hdst, 0, n - 1)]
     tables = seg.build_hash_totals(
         jnp.concatenate([hyb.hsrc, nodes]),
         jnp.concatenate([lab_hdst, labels]),
@@ -341,14 +373,14 @@ def _move_step_hybrid(hyb: da.HybridAdj, slab: GraphSlab, labels: jax.Array,
     gain_h = tot_h - gamma * k_i_h * (sig_h -
                                       jnp.where(own_h, k_i_h, 0.0)) / m2
     if theta > 0.0:
-        u = seg.pair_jitter(k_hub, hyb.hsrc, lab_hdst, 1.0)
+        u = seg.pair_jitter(k_hub, hyb.hsrc, rep_h, 1.0)
         score_h = _theta_score(gain_h, u, hyb.hvalid & ~own_h, theta, m2)
         best_h, _, has_h = seg.scatter_argmax_label(
             hyb.hsrc, score_h, lab_hdst, hyb.hvalid, n)
         want_h = has_h & (best_h >= 0) & (best_h != labels)
     else:
         score_h = jnp.where(hyb.hvalid, gain_h + seg.pair_jitter(
-            k_hub, hyb.hsrc, lab_hdst, _JITTER_REL / m2), -jnp.inf)
+            k_hub, hyb.hsrc, rep_h, _JITTER_REL / m2), -jnp.inf)
         best_h, bs_h, has_h = seg.scatter_argmax_label(
             hyb.hsrc, score_h, lab_hdst, hyb.hvalid, n)
         stay_tot = seg.lookup_hash_totals(tables, nodes, labels)
@@ -383,11 +415,13 @@ def _move_step_dense(adj: da.DenseAdj, slab: GraphSlab, labels: jax.Array,
     sig = sigma_tot[jnp.clip(tot.label, 0, n - 1)]
     own = tot.label == labels[:, None]
     gain = tot.total - gamma * k_i * (sig - jnp.where(own, k_i, 0.0)) / m2
+    nodes = jnp.arange(n, dtype=jnp.int32)
+    rep = _community_reps(labels, n)[jnp.clip(tot.label, 0, n - 1)]
     if theta > 0.0:
-        u = seg.uniform_jitter(k_tie, gain.shape, 1.0)
+        u = seg.pair_jitter(k_tie, nodes[:, None], rep, 1.0)
         score = _theta_score(gain, u, tot.is_head & ~own, theta, m2)
         return da.best_candidate(tot, score, labels)
-    jitter = seg.uniform_jitter(k_tie, gain.shape, _JITTER_REL / m2)
+    jitter = seg.pair_jitter(k_tie, nodes[:, None], rep, _JITTER_REL / m2)
     score = jnp.where(tot.is_head, gain + jitter, -jnp.inf)
 
     best, want = da.best_candidate(tot, score, labels)
@@ -834,6 +868,10 @@ def make_louvain(max_sweeps: int = 32, update_prob: float = 0.5,
     det.warm_variant = ensemble(functools.partial(
         louvain_single, max_sweeps=min(warm_sweep_budget(), max_sweeps),
         update_prob=update_prob, gamma=gamma))
+    # tie-break jitter is content-keyed (_community_reps), so endgame key
+    # sharing (ConsensusConfig.align_frac) collapses degenerate
+    # disagreements instead of merely deleting ensemble randomness
+    det.supports_align = True
     return det
 
 

@@ -48,6 +48,26 @@ def enabled() -> bool:
     return os.environ.get("FCTPU_CALIBRATE", "1") != "0"
 
 
+def atomic_write_json(path: str, obj) -> bool:
+    """tmp + rename JSON write; False (with a debug log) on OSError.
+
+    Shared by every small-JSON persistence site (rates here, the detect
+    chunk-sizing file in consensus.py): these files are optimizations, so
+    a read-only or full filesystem must never abort the run.
+    """
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   suffix=".tmp")
+        with os.fdopen(fd, "w") as fh:
+            json.dump(obj, fh, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return True
+    except OSError as e:
+        _logger.debug("not persisted (%s): %s", path, e)
+        return False
+
+
 def _rates_path(backend: str) -> str:
     d = os.environ.get("FCTPU_CALIBRATE_DIR") or \
         os.environ.get("JAX_COMPILATION_CACHE_DIR") or \
@@ -106,12 +126,5 @@ def update_rate(backend: str, move_path: str, alg: str, ns_per_byte: float,
     old = entry.get(kind)
     entry[kind] = 0.5 * (old + ns_per_byte) if old else ns_per_byte
     rates[f"{move_path}/{alg}"] = entry
-    try:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
-        with os.fdopen(fd, "w") as fh:
-            json.dump(rates, fh, indent=2, sort_keys=True)
-        os.replace(tmp, path)
-    except OSError as e:  # read-only cache dir: keep the in-process value
-        _logger.debug("calibration rate not persisted: %s", e)
+    atomic_write_json(path, rates)  # failure: keep the in-process value
     _cache = rates
