@@ -107,6 +107,10 @@ class RoundStats(NamedTuple):
     n_hub_overflow: jax.Array  # int32[] hub directed edges beyond hub_cap,
                                # i.e. dropped from the hybrid path's hashed
                                # move candidates (ops/dense_adj.build_hybrid)
+    cold: jax.Array            # bool[] this round ran full-sweep singleton
+                               # -start detection (round 0 / cold mode /
+                               # stagnation refresh); drives the stall
+                               # reset and is recorded in history
 
 
 def consensus_tail(slab: GraphSlab,
@@ -171,8 +175,25 @@ def consensus_tail(slab: GraphSlab,
         n_dropped=n_dropped,
         n_overflow=n_overflow,
         n_hub_overflow=n_hub_overflow,
+        cold=jnp.bool_(False),  # the caller (driver / block body) knows
     )
     return slab, stats
+
+
+def _stall_floor(delta: float, n_alive) -> jnp.float32:
+    """Minimum mid-weight edge count for the stagnation rule to apply.
+
+    A 10%-relative rule alone misfires at endgame granularity (12 -> 11
+    unconverged is an 8% "stall") and near the convergence bar, where a
+    cold restart would blow away nearly-converged state the alignment
+    endgame is about to finish.  Stagnation therefore requires the count
+    to still sit at >= 4x the ``delta`` convergence bar AND >= 64
+    absolute (delta=0 runs).  f32 arithmetic, shared bit-exactly by the
+    host (run_consensus.stalled) and the fused block.
+    """
+    bar = jnp.float32(4.0) * jnp.float32(delta) * \
+        jnp.asarray(n_alive, jnp.float32)
+    return jnp.maximum(jnp.float32(64.0), bar)
 
 
 def _maybe_align_keys(keys: jax.Array, align) -> jax.Array:
@@ -275,6 +296,7 @@ def consensus_rounds_block(slab: GraphSlab,
                            start_round: jax.Array,
                            max_iters: jax.Array,
                            align0: jax.Array,
+                           unconv0: jax.Array,
                            detect: Detector,
                            detect_warm: Detector,
                            n_p: int,
@@ -313,40 +335,69 @@ def consensus_rounds_block(slab: GraphSlab,
     from its own stats, so fused and per-round execution stay bit-identical
     — the contract above.  ``align_frac=0`` keeps alignment off (the
     driver passes 0 for detectors without content-keyed tie-breaks).
-    In-block rounds past the first always start from real carried labels,
-    so alignment can never clone a singleton-start round.
+
+    ``unconv0`` (traced int32[3] = [u_prev2, u_prev1, alive_prev1], -1 =
+    unknown) is the stagnation state entering the block: a warm round that
+    fails to shrink the mid-weight edge count by >= 10% — while that count
+    is still far above the convergence bar (``_stall_floor``) — marks the
+    run *stagnated*, and the next round re-detects COLD: singleton init,
+    the full-sweep base detector, independent keys.  This restores the
+    cold engine's convergence pressure when warm members lock into diverse
+    local optima (measured round 3: warm leiden on lfr10k never converges
+    — the consensus graph grows ~30k edges/round while disagreement
+    persists).  A cold round resets the state (its own fresh disagreement
+    must not immediately re-trigger), so warm rounds resume from the
+    refreshed labels.  Same f32 rule as the driver's ``stalled()``.
     """
     def empty_stats():
         z = jnp.zeros((block,), jnp.int32)
         return RoundStats(converged=jnp.zeros((block,), bool), n_alive=z,
                           n_unconverged=z, n_closure_added=z, n_repaired=z,
-                          n_dropped=z, n_overflow=z, n_hub_overflow=z)
+                          n_dropped=z, n_overflow=z, n_hub_overflow=z,
+                          cold=jnp.zeros((block,), bool))
 
     def cond(carry):
-        _, i, conv, _, _, _ = carry
+        _, i, conv, _, _, _, _ = carry
         return (~conv) & (i < block) & (i < max_iters)
 
     def body(carry):
-        slab, i, _, buf, labels, aligned = carry
+        slab, i, _, buf, labels, aligned, prev = carry
         k = prng.stream(key, prng.STREAM_ROUND, start_round + i)
-        if warm and detect_warm is not detect:
-            def run(d):
-                def go(op):
-                    s, kk, lab, al = op
-                    return consensus_round(
-                        s, kk, detect=d, n_p=n_p, tau=tau, delta=delta,
-                        n_closure=n_closure, init_labels=lab, align=al)
-                return go
+        if warm:
+            stall = (prev[0] >= 0) & (prev[1] >= 0) & \
+                (prev[1].astype(jnp.float32) >=
+                 jnp.float32(0.9) * prev[0].astype(jnp.float32)) & \
+                (prev[1].astype(jnp.float32) >=
+                 _stall_floor(delta, prev[2]))
+            cold = (start_round + i == 0) | stall
+
+            def run_cold(op):
+                s, kk, lab, _ = op
+                sing = jnp.broadcast_to(
+                    jnp.arange(lab.shape[1], dtype=jnp.int32), lab.shape)
+                return consensus_round(
+                    s, kk, detect=detect, n_p=n_p, tau=tau, delta=delta,
+                    n_closure=n_closure, init_labels=sing, align=False)
+
+            def run_warm(op):
+                s, kk, lab, al = op
+                return consensus_round(
+                    s, kk, detect=detect_warm, n_p=n_p, tau=tau,
+                    delta=delta, n_closure=n_closure, init_labels=lab,
+                    align=al)
 
             slab, labels, st = jax.lax.cond(
-                start_round + i == 0, run(detect), run(detect_warm),
-                (slab, k, labels, aligned))
+                cold, run_cold, run_warm, (slab, k, labels, aligned))
+            st = st._replace(cold=cold)
+            # cold rounds reset the stagnation pair: sentinel out u_prev2
+            prev = jnp.stack([jnp.where(cold, jnp.int32(-1), prev[1]),
+                              st.n_unconverged, st.n_alive])
         else:
             slab, labels, st = consensus_round(
                 slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
-                n_closure=n_closure,
-                init_labels=labels if warm else None,
-                align=aligned if warm else False)
+                n_closure=n_closure, init_labels=None, align=False)
+            st = st._replace(cold=jnp.bool_(True))
+            prev = jnp.stack([prev[1], st.n_unconverged, st.n_alive])
         buf = jax.tree.map(lambda b, s: b.at[i].set(s), buf, st)
         if warm and align_frac > 0:
             aligned = st.n_unconverged.astype(jnp.float32) <= \
@@ -354,12 +405,12 @@ def consensus_rounds_block(slab: GraphSlab,
                 jnp.maximum(st.n_alive, 1).astype(jnp.float32)
         else:
             aligned = jnp.bool_(False)
-        return slab, i + 1, st.converged, buf, labels, aligned
+        return slab, i + 1, st.converged, buf, labels, aligned, prev
 
-    slab, done, _, buf, labels, _ = jax.lax.while_loop(
+    slab, done, _, buf, labels, _, _ = jax.lax.while_loop(
         cond, body,
         (slab, jnp.int32(0), jnp.bool_(False), empty_stats(), labels0,
-         jnp.asarray(align0, bool)))
+         jnp.asarray(align0, bool), jnp.asarray(unconv0, jnp.int32)))
     return slab, done, buf, labels
 
 
@@ -889,12 +940,41 @@ def run_consensus(slab: GraphSlab,
                 measured_member_s, members, m, fused_block, fb)
             setup_executables()
 
-    def detect_for_round(r0: int) -> Detector:
-        """Full-sweep base detector for the singleton-start round; the
-        capped-sweep warm variant for every warm-started round after it."""
+    def stalled() -> bool:
+        """Warm stagnation: the last round failed to shrink the mid-weight
+        edge count by >= 10% while still far from converging
+        (_stall_floor).  Warm members can lock into diverse local optima —
+        each is at ITS fixpoint, so disagreement stops falling while
+        triadic closure keeps densifying the graph (measured round 3: warm
+        leiden on lfr10k grew the consensus graph ~30k edges/round without
+        ever converging).  The cure is a COLD round: re-derive every
+        member from the current weights with independent keys, then resume
+        warm from the refreshed labels.  A cold round resets the state
+        (its fresh disagreement must not immediately re-trigger).  f32
+        compare, matching the in-block rule bit-exactly."""
+        if not warm or len(history) < 2:
+            return False
+        if history[-1].get("cold"):
+            return False
+        u2 = history[-2]["n_unconverged"]
+        u1 = history[-1]["n_unconverged"]
+        return bool(np.float32(u1) >= np.float32(0.9) * np.float32(u2)) \
+            and bool(np.float32(u1) >= np.asarray(_stall_floor(
+                config.delta, history[-1]["n_alive"])))
+
+    def cold_this_round(r0: int) -> bool:
+        """Full-sweep singleton-start detection this round?  (The round-0
+        cold start, every round of a cold-mode run, or a warm-stagnation
+        refresh.)"""
         if not warm or r0 == cold_start_round:
-            return detect
-        return detect_warm
+            return True
+        if stalled():
+            _logger.warning(
+                "warm stagnation (unconverged %d -> %d): round %d "
+                "re-detects cold", history[-2]["n_unconverged"],
+                history[-1]["n_unconverged"], r0)
+            return True
+        return False
 
     def align_now(r0: int) -> bool:
         """Share one detection key across members in round ``r0``?  Engages
@@ -948,6 +1028,7 @@ def run_consensus(slab: GraphSlab,
             "n_dropped": int(stats.n_dropped),
             "n_overflow": int(stats.n_overflow),
             "n_hub_overflow": int(stats.n_hub_overflow),
+            "cold": bool(stats.cold),
             "capacity": slab.capacity,
         }
         history.append(entry)
@@ -965,13 +1046,16 @@ def run_consensus(slab: GraphSlab,
     # the first resumed round of a labels-less legacy checkpoint) runs the
     # full-sweep base detector.
     cold_start_round = start_round if cur_labels is None else -1
+    # Round-0 warm init = singletons, which is exactly what every kernel's
+    # cold start uses — so warm mode needs only one trace and round 0 is
+    # bit-identical to a cold run.  Stagnation-refresh rounds
+    # (cold_this_round) reuse the same singleton init, and therefore the
+    # same compiled executable as round 0.
+    sing_labels = jnp.broadcast_to(
+        jnp.arange(slab.n_nodes, dtype=jnp.int32),
+        (config.n_p, slab.n_nodes)) if warm else None
     if warm and cur_labels is None:
-        # Round-0 warm init = singletons, which is exactly what every
-        # kernel's cold start uses — so warm mode needs only one trace and
-        # round 0 is bit-identical to a cold run.
-        cur_labels = jnp.broadcast_to(
-            jnp.arange(slab.n_nodes, dtype=jnp.int32),
-            (config.n_p, slab.n_nodes))
+        cur_labels = sing_labels
     r = start_round
     while r < end_round:
         maybe_resize()
@@ -979,10 +1063,17 @@ def run_consensus(slab: GraphSlab,
         if fused_block > 1:
             labels0 = cur_labels if warm else jnp.zeros(
                 (config.n_p, slab.n_nodes), jnp.int32)
+            unconv0 = jnp.asarray(
+                [history[-2]["n_unconverged"]
+                 if len(history) >= 2 and not history[-1].get("cold")
+                 else -1,
+                 history[-1]["n_unconverged"] if history else -1,
+                 history[-1]["n_alive"] if history else -1],
+                jnp.int32)
             t0 = time.perf_counter()
             slab, done, buf, new_labels = block_fn(
                 slab, key, labels0, jnp.int32(r), jnp.int32(end_round - r),
-                jnp.bool_(align_now(r)))
+                jnp.bool_(align_now(r)), unconv0)
             done = int(done)
             buf = jax.device_get(buf)
             dt = time.perf_counter() - t0
@@ -997,12 +1088,16 @@ def run_consensus(slab: GraphSlab,
                 continue
             if not first_call and done > 0:
                 # the first call of a fresh executable pays the compile;
-                # later blocks measure the true on-device round rate (warm
-                # rounds when warm-starting: any non-first block is past
-                # absolute round 0)
+                # later blocks measure the true on-device round rate.
+                # A block mixing stagnation-cold and warm rounds yields a
+                # blended rate: fine for in-run sizing (conservative), but
+                # not persisted — it would pollute the warm calibration.
                 measured_member_s = dt / (done * config.n_p)
                 measured_in_process = True
-                record_rate(measured_member_s, cold=not warm, call_s=dt)
+                any_cold = any(bool(buf.cold[i]) for i in range(done))
+                if not (warm and any_cold):
+                    record_rate(measured_member_s, cold=not warm,
+                                call_s=dt)
             if warm:
                 cur_labels = new_labels
             for i in range(done):
@@ -1016,19 +1111,21 @@ def run_consensus(slab: GraphSlab,
             if split_phase:
                 # same key derivation as consensus_round, so split and
                 # one-call execution produce identical results
+                is_cold = cold_this_round(r)
                 k_detect, k_closure = jax.random.split(k)
                 keys = prng.partition_keys(k_detect, config.n_p)
-                if align_now(r):
+                if align_now(r) and not is_cold:
                     # endgame alignment: every member draws member 0's key
                     # (tie-break jitter is community-content-keyed, so
                     # members still differ through their warm labels)
                     keys = keys[jnp.zeros((config.n_p,), jnp.int32)]
                 timings: List[float] = []
                 labels = _detect_chunked(
-                    detect_for_round(r), slab, keys, members,
+                    detect if is_cold else detect_warm, slab, keys, members,
                     cache_dir=detect_cache_dir,
                     cache_tag=f"{cache_fp}_r{r}",
-                    init_labels=cur_labels if warm else None,
+                    init_labels=(sing_labels if is_cold else cur_labels)
+                    if warm else None,
                     ensemble_sharding=ensemble_sharding,
                     timings=timings)
                 if timings:
@@ -1041,8 +1138,7 @@ def run_consensus(slab: GraphSlab,
                     # executables this round still needs (ADVICE round 2).
                     measured_member_s = float(np.median(timings))
                     measured_in_process = True
-                    record_rate(measured_member_s,
-                                cold=not warm or r == cold_start_round,
+                    record_rate(measured_member_s, cold=not warm or is_cold,
                                 call_s=measured_member_s * members)
                 slab, stats = _jitted_tail(
                     config.n_p, config.tau, config.delta, n_closure)(
@@ -1062,17 +1158,20 @@ def run_consensus(slab: GraphSlab,
                 if warm:
                     cur_labels = labels
             else:
-                round_detect = detect_for_round(r)
+                is_cold = cold_this_round(r)
+                round_detect = detect if is_cold else detect_warm
                 round_fn = _jitted_round(  # lru-cached: cheap per round
                     round_detect, config.n_p, config.tau,
                     config.delta, n_closure, ensemble_sharding)
                 t0 = time.perf_counter()
                 if warm:
                     # align passed traced: flipping it mid-run reuses the
-                    # same executable (no endgame recompile)
+                    # same executable (no endgame recompile); cold refresh
+                    # rounds take singleton init — round 0's executable
                     slab_new, new_labels, stats = round_fn(
-                        slab, k, init_labels=cur_labels,
-                        align=jnp.bool_(align_now(r)))
+                        slab, k,
+                        init_labels=sing_labels if is_cold else cur_labels,
+                        align=jnp.bool_(align_now(r) and not is_cold))
                 else:
                     slab_new, new_labels, stats = round_fn(slab, k)
                 slab = slab_new
@@ -1096,10 +1195,12 @@ def run_consensus(slab: GraphSlab,
                     # — detection dominates at every measured config)
                     measured_member_s = dt / config.n_p
                     measured_in_process = True
-                    record_rate(measured_member_s, cold=not warm, call_s=dt)
+                    record_rate(measured_member_s, cold=not warm or is_cold,
+                                call_s=dt)
                 if warm:
                     cur_labels = new_labels
             r += 1
+            stats = stats._replace(cold=np.bool_(is_cold))
             record(stats)
             if checkpoint_path is not None and \
                     (rounds % checkpoint_every == 0 or converged):

@@ -353,6 +353,58 @@ def test_warm_start_quality_and_rounds_leiden():
     assert warm.rounds <= cold.rounds + 1, (warm.rounds, cold.rounds)
 
 
+def test_warm_stagnation_triggers_cold_refresh(tmp_path, caplog):
+    """A warm run whose disagreement stops shrinking must re-detect cold
+    (stagnation refresh) instead of grinding on: warm members locked into
+    diverse local optima keep the same mid-weight edges forever while
+    closure densifies the graph (observed on lfr10k/leiden, round 3)."""
+    import logging
+
+    from fastconsensus_tpu.utils.synth import planted_partition
+
+    class StickyDetector:
+        """Cold (singleton init — the kernels' cold-start convention the
+        stagnation refresh relies on): per-member random split, permanent
+        disagreement.  Warm: returns the init labels unchanged (a fully
+        locked-in member)."""
+
+        supports_init = True
+
+        def __call__(self, slab, keys, init_labels=None):
+            rand = jax.vmap(lambda k: jax.random.bernoulli(
+                k, 0.5, (slab.n_nodes,)).astype(jnp.int32))(keys)
+            if init_labels is None:
+                return rand
+            is_sing = jnp.all(
+                init_labels == jnp.arange(slab.n_nodes)[None, :])
+            return jnp.where(is_sing, rand, init_labels.astype(jnp.int32))
+
+    edges, _ = planted_partition(120, 4, 0.35, 0.02, seed=8)
+    slab = pack_edges(edges, 120)
+    cfg = ConsensusConfig(algorithm="sticky", n_p=8, tau=0.4, delta=0.0,
+                          max_rounds=6, seed=2)
+    det = StickyDetector()
+    with caplog.at_level(logging.WARNING, logger="fastconsensus_tpu"):
+        # checkpoint_path forces the per-round path
+        single = run_consensus(slab, det, cfg,
+                               checkpoint_path=str(tmp_path / "ck.npz"))
+    assert any("stagnation" in m for m in caplog.messages), caplog.messages
+    colds = [h["cold"] for h in single.history]
+    assert colds[0] and any(colds[1:]), colds       # refresh actually ran
+    assert not all(colds[1:]), colds                # ...and state resets
+
+    # fused blocks implement the same stall rule in-traced: bit parity
+    # (capacity stripped — a block records its post-growth capacity for
+    # every round it contains, the per-round path records it pre-growth)
+    fused = run_consensus(slab, det, cfg)
+    assert fused.rounds == single.rounds
+    strip = lambda h: {k: v for k, v in h.items() if k != "capacity"}
+    for a, b in zip(fused.history, single.history):
+        assert strip(a) == strip(b)
+    for pa, pb in zip(fused.partitions, single.partitions):
+        np.testing.assert_array_equal(pa, pb)
+
+
 def test_endgame_alignment_converges_no_slower(tmp_path):
     """ConsensusConfig.align_frac: once nearly converged, members share one
     detection key so content-keyed tie-breaks (louvain._community_reps)
