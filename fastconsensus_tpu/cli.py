@@ -67,6 +67,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="disable slab self-sizing; saturated rounds drop "
                         "closure candidates with a reported count (the "
                         "round-1 behavior)")
+    p.add_argument("--align-frac", type=float, default=None,
+                   metavar="FRAC",
+                   help="unconverged-edge fraction below which detection "
+                        "rounds share one PRNG key across ensemble members "
+                        "(endgame tie-break alignment; 0 disables, 1 aligns "
+                        "every warm round; default: engine default)")
     p.add_argument("--cold-detect", action="store_true",
                    help="disable warm-started detection (every round "
                         "re-derives partitions from singletons, like the "
@@ -147,11 +153,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    extra_cfg = {}
+    if args.align_frac is not None:
+        extra_cfg["align_frac"] = args.align_frac
     cfg = ConsensusConfig(algorithm=args.alg, n_p=args.n_p, tau=args.tau,
                           delta=args.delta, max_rounds=args.max_rounds,
                           seed=args.seed, gamma=args.gamma,
                           auto_grow=not args.no_grow,
-                          warm_start=not args.cold_detect)
+                          warm_start=not args.cold_detect, **extra_cfg)
     from fastconsensus_tpu.utils.trace import RoundTracer, profiler_trace
 
     tracer = RoundTracer(jsonl_path=args.trace_jsonl)

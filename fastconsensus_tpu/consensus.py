@@ -91,8 +91,11 @@ class ConsensusConfig:
     # round blocks re-derive the flag per round from their own stats, so
     # fused and per-round execution stay bit-identical.  Detectors without
     # content-keyed tie-breaks (supports_align unset: lpm, native
-    # cnm/infomap) ignore it.  0 disables.
-    align_frac: float = 0.25
+    # cnm/infomap) ignore it.  0 disables.  Default 0.4: the ambiguous
+    # configs plateau at unconverged fractions around 0.3-0.4 (lfr10k
+    # mu=0.5 measured round 3) — a threshold below the plateau never
+    # engages exactly where alignment is needed most.
+    align_frac: float = 0.4
 
 
 class RoundStats(NamedTuple):
@@ -130,15 +133,20 @@ def consensus_tail(slab: GraphSlab,
     st_mid = cops.convergence_stats(slab, n_p, delta)
 
     def do_closure(slab):
+        # sort-free ops throughout: the CSR/lexsort variants re-gather the
+        # whole slab on an edge-sharded mesh (sample_wedges_scatter /
+        # insert_edges_hash docstrings)
         n0 = slab.num_alive()
-        csr = cops.build_csr(slab)
-        cu, cv, cvalid = cops.sample_wedges(k_closure, csr, slab.n_nodes,
-                                            n_closure)
+        cu, cv, cvalid = cops.sample_wedges_scatter(k_closure, slab,
+                                                    n_closure)
         cw = cops.comembership_counts(labels, cu, cv)
-        slab, dropped = cops.insert_edges(slab, cu, cv, cw, cvalid)
+        slab, dropped = cops.insert_edges_hash(slab, cu, cv, cw, cvalid)
         n1 = slab.num_alive()
         su, sv, sw, svalid = cops.singleton_candidates(slab, prev)
-        slab, dropped2 = cops.insert_edges(slab, su, sv, sw, svalid)
+        # repair candidates are unique + absent by construction: exact
+        # insert — a reattachment must never be lost to a hash collision
+        slab, dropped2 = cops.insert_edges_hash(slab, su, sv, sw, svalid,
+                                                unique_new=True)
         return slab, n1 - n0, slab.num_alive() - n1, dropped + dropped2
 
     def skip_closure(slab):
@@ -264,8 +272,18 @@ def consensus_round(slab: GraphSlab,
         labels = detect(slab, keys, init_labels)
     else:
         labels = detect(slab, keys)
-    slab, stats = consensus_tail(slab, labels, k_closure, n_p, tau, delta,
-                                 n_closure)
+    if ensemble_sharding is not None:
+        # explicit edge-local tail: GSPMD re-gathers the tail's scatters
+        # and concatenates capacity-wide (ops/sharded_tail.py docstring);
+        # bit-identical to the unsharded tail below
+        from fastconsensus_tpu.ops import sharded_tail as stail
+
+        slab, stats = stail.sharded_consensus_tail(
+            slab, labels, k_closure, n_p, tau, delta, n_closure,
+            ensemble_sharding.mesh)
+    else:
+        slab, stats = consensus_tail(slab, labels, k_closure, n_p, tau,
+                                     delta, n_closure)
     return slab, labels, stats
 
 
@@ -299,6 +317,7 @@ def consensus_rounds_block(slab: GraphSlab,
                            unconv0: jax.Array,
                            detect: Detector,
                            detect_warm: Detector,
+                           detect_refresh: Detector,
                            n_p: int,
                            tau: float,
                            delta: float,
@@ -369,15 +388,31 @@ def consensus_rounds_block(slab: GraphSlab,
                  jnp.float32(0.9) * prev[0].astype(jnp.float32)) & \
                 (prev[1].astype(jnp.float32) >=
                  _stall_floor(delta, prev[2]))
-            cold = (start_round + i == 0) | stall
+            # alignment supersedes the refresh (run_consensus.round_mode):
+            # `aligned` is exactly "this round will run aligned"
+            cold = (start_round + i == 0) | (stall & ~aligned)
+
+            def run_singleton(d):
+                def go(op):
+                    s, kk, lab, _ = op
+                    sing = jnp.broadcast_to(
+                        jnp.arange(lab.shape[1], dtype=jnp.int32),
+                        lab.shape)
+                    return consensus_round(
+                        s, kk, detect=d, n_p=n_p, tau=tau, delta=delta,
+                        n_closure=n_closure, init_labels=sing,
+                        align=False)
+                return go
 
             def run_cold(op):
-                s, kk, lab, _ = op
-                sing = jnp.broadcast_to(
-                    jnp.arange(lab.shape[1], dtype=jnp.int32), lab.shape)
-                return consensus_round(
-                    s, kk, detect=detect, n_p=n_p, tau=tau, delta=delta,
-                    n_closure=n_closure, init_labels=sing, align=False)
+                # round 0: the theta-randomized base detector (ensemble
+                # diversity); stagnation refresh: the low-variance
+                # refresh variant (models/leiden.py refresh_variant)
+                if detect_refresh is detect:
+                    return run_singleton(detect)(op)
+                return jax.lax.cond(
+                    start_round + i == 0, run_singleton(detect),
+                    run_singleton(detect_refresh), op)
 
             def run_warm(op):
                 s, kk, lab, al = op
@@ -415,17 +450,26 @@ def consensus_rounds_block(slab: GraphSlab,
 
 
 @functools.lru_cache(maxsize=128)
-def _jitted_rounds_block(detect: Detector, detect_warm: Detector, n_p: int,
+def _jitted_rounds_block(detect: Detector, detect_warm: Detector,
+                         detect_refresh: Detector, n_p: int,
                          tau: float, delta: float, n_closure: int,
                          block: int, warm: bool, align_frac: float = 0.0):
     return jax.jit(functools.partial(
         consensus_rounds_block, detect=detect, detect_warm=detect_warm,
-        n_p=n_p, tau=tau, delta=delta, n_closure=n_closure, block=block,
-        warm=warm, align_frac=align_frac))
+        detect_refresh=detect_refresh, n_p=n_p, tau=tau, delta=delta,
+        n_closure=n_closure, block=block, warm=warm,
+        align_frac=align_frac))
 
 
 @functools.lru_cache(maxsize=128)
-def _jitted_tail(n_p: int, tau: float, delta: float, n_closure: int):
+def _jitted_tail(n_p: int, tau: float, delta: float, n_closure: int,
+                 mesh=None):
+    if mesh is not None:
+        from fastconsensus_tpu.ops import sharded_tail as stail
+
+        return jax.jit(functools.partial(
+            stail.sharded_consensus_tail, n_p=n_p, tau=tau, delta=delta,
+            n_closure=n_closure, mesh=mesh))
     return jax.jit(functools.partial(
         consensus_tail, n_p=n_p, tau=tau, delta=delta, n_closure=n_closure))
 
@@ -677,6 +721,11 @@ def run_consensus(slab: GraphSlab,
     # warm rounds must *bound* sweeps to realize the warm-start savings.
     detect_warm = (getattr(detect, "warm_variant", None) or detect) \
         if warm else detect
+    # Stagnation refreshes use a LOW-VARIANCE full-sweep variant when the
+    # detector provides one (leiden: theta=0 — theta-resampling on every
+    # refresh would re-inject the cross-member variance the refresh exists
+    # to burn down; see models/leiden.py).
+    detect_refresh = getattr(detect, "refresh_variant", None) or detect
     # Last successful round's labels [n_p, N] (device-resident); None until
     # the first round completes.  Seeds warm detection and the final
     # re-detection; persisted in checkpoints so resume stays bit-identical.
@@ -881,8 +930,8 @@ def run_consensus(slab: GraphSlab,
         block_fn = None
         if fused_block > 1:
             block_fn = _jitted_rounds_block(
-                detect, detect_warm, config.n_p, config.tau, config.delta,
-                n_closure, fused_block, warm,
+                detect, detect_warm, detect_refresh, config.n_p,
+                config.tau, config.delta, n_closure, fused_block, warm,
                 config.align_frac if (warm and align_ok) else 0.0)
 
     # Executable identities that already ran at least once since the last
@@ -962,19 +1011,28 @@ def run_consensus(slab: GraphSlab,
             and bool(np.float32(u1) >= np.asarray(_stall_floor(
                 config.delta, history[-1]["n_alive"])))
 
-    def cold_this_round(r0: int) -> bool:
-        """Full-sweep singleton-start detection this round?  (The round-0
-        cold start, every round of a cold-mode run, or a warm-stagnation
-        refresh.)"""
+    def round_mode(r0: int) -> str:
+        """"cold" (round-0 / cold-run full-sweep base detector),
+        "refresh" (warm-stagnation full-sweep low-variance refresh), or
+        "warm" (capped-sweep warm variant).
+
+        Alignment SUPERSEDES the stagnation refresh: an aligned round's
+        residual disagreement is structural, and a refresh re-randomizes
+        every member with independent keys — measured on lfr10k (twice):
+        aligned rounds shrank the unconverged fraction monotonically
+        0.97 -> 0.24, then a refresh bounced it to 0.29+ and the run
+        re-diverged.  The refresh exists for UNALIGNED warm lock-in."""
         if not warm or r0 == cold_start_round:
-            return True
+            return "cold"
+        if align_now(r0):
+            return "warm"
         if stalled():
             _logger.warning(
                 "warm stagnation (unconverged %d -> %d): round %d "
                 "re-detects cold", history[-2]["n_unconverged"],
                 history[-1]["n_unconverged"], r0)
-            return True
-        return False
+            return "refresh"
+        return "warm"
 
     def align_now(r0: int) -> bool:
         """Share one detection key across members in round ``r0``?  Engages
@@ -1049,7 +1107,7 @@ def run_consensus(slab: GraphSlab,
     # Round-0 warm init = singletons, which is exactly what every kernel's
     # cold start uses — so warm mode needs only one trace and round 0 is
     # bit-identical to a cold run.  Stagnation-refresh rounds
-    # (cold_this_round) reuse the same singleton init, and therefore the
+    # (round_mode "refresh") reuse the same singleton init, and therefore the
     # same compiled executable as round 0.
     sing_labels = jnp.broadcast_to(
         jnp.arange(slab.n_nodes, dtype=jnp.int32),
@@ -1111,7 +1169,10 @@ def run_consensus(slab: GraphSlab,
             if split_phase:
                 # same key derivation as consensus_round, so split and
                 # one-call execution produce identical results
-                is_cold = cold_this_round(r)
+                mode = round_mode(r)
+                is_cold = mode != "warm"
+                det_r = {"cold": detect, "refresh": detect_refresh,
+                         "warm": detect_warm}[mode]
                 k_detect, k_closure = jax.random.split(k)
                 keys = prng.partition_keys(k_detect, config.n_p)
                 if align_now(r) and not is_cold:
@@ -1121,7 +1182,7 @@ def run_consensus(slab: GraphSlab,
                     keys = keys[jnp.zeros((config.n_p,), jnp.int32)]
                 timings: List[float] = []
                 labels = _detect_chunked(
-                    detect if is_cold else detect_warm, slab, keys, members,
+                    det_r, slab, keys, members,
                     cache_dir=detect_cache_dir,
                     cache_tag=f"{cache_fp}_r{r}",
                     init_labels=(sing_labels if is_cold else cur_labels)
@@ -1141,8 +1202,8 @@ def run_consensus(slab: GraphSlab,
                     record_rate(measured_member_s, cold=not warm or is_cold,
                                 call_s=measured_member_s * members)
                 slab, stats = _jitted_tail(
-                    config.n_p, config.tau, config.delta, n_closure)(
-                    slab, labels, k_closure)
+                    config.n_p, config.tau, config.delta, n_closure,
+                    mesh)(slab, labels, k_closure)
                 stats = jax.device_get(stats)
                 while config.auto_grow and int(stats.n_dropped) > 0:
                     # capacity only matters after detection: replay just
@@ -1152,14 +1213,16 @@ def run_consensus(slab: GraphSlab,
                     # split-phase exists for)
                     grow_and_replay(pre_slab, int(stats.n_dropped))
                     slab, stats = _jitted_tail(
-                        config.n_p, config.tau, config.delta, n_closure)(
-                        slab, labels, k_closure)
+                        config.n_p, config.tau, config.delta, n_closure,
+                        mesh)(slab, labels, k_closure)
                     stats = jax.device_get(stats)
                 if warm:
                     cur_labels = labels
             else:
-                is_cold = cold_this_round(r)
-                round_detect = detect if is_cold else detect_warm
+                mode = round_mode(r)
+                is_cold = mode != "warm"
+                round_detect = {"cold": detect, "refresh": detect_refresh,
+                                "warm": detect_warm}[mode]
                 round_fn = _jitted_round(  # lru-cached: cheap per round
                     round_detect, config.n_p, config.tau,
                     config.delta, n_closure, ensemble_sharding)

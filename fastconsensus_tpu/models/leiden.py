@@ -112,6 +112,17 @@ def make_leiden(max_sweeps: int = 32, gamma: float = 1.0,
         leiden_single, max_sweeps=min(warm_sweep_budget(), max_sweeps),
         gamma=gamma, theta=0.0))
     det.warm_variant.cost_mult = 4.0
+    # Stagnation-refresh rounds (consensus.py round_mode "refresh") re-derive
+    # every member from scratch on the current weights — with theta=0:
+    # theta-resampling on every refresh re-injects exactly the
+    # cross-member variance the refresh is trying to burn down (measured
+    # round 3: lfr10k mu=0.5 diverges — consecutive theta-randomized cold
+    # rounds RAISED the mid-weight count every round).  The user-visible
+    # leidenalg-parity surface — fresh detections and the true round-0
+    # cold start — keeps the theta-randomized distribution.
+    det.refresh_variant = ensemble(functools.partial(
+        leiden_single, max_sweeps=max_sweeps, gamma=gamma, theta=0.0))
+    det.refresh_variant.cost_mult = 4.0
     # all three phases run louvain's move machinery, whose tie-break jitter
     # is content-keyed (louvain._community_reps) — see ConsensusConfig.align_frac
     det.supports_align = True

@@ -130,6 +130,152 @@ def sample_wedges(key: jax.Array, csr: CSR, n_nodes: int, n_samples: int
     return u, v, valid
 
 
+def sample_wedges_scatter(key: jax.Array, slab: GraphSlab, n_samples: int
+                          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sort-free triadic-closure sampling (reference fc:175-191 semantics).
+
+    The CSR-based :func:`sample_wedges` needs a global argsort of the
+    directed edges every round; under an edge-sharded mesh that sort
+    re-gathers the whole slab onto every device (parallel/sharding.py
+    module notes).  This variant draws, per round, ``ceil(L / N)`` rounds
+    of *random-partner pairs*: for every node u, two independent uniform
+    random alive neighbors p1(u), p2(u), each realized as a scatter-argmax
+    over per-directed-edge priorities — O(E) scatter work that XLA keeps
+    edge-local.  A draw's candidate for anchor u is (p1(u), p2(u)),
+    rejected when equal (matches the reference's distinct-pair rule; a
+    degree-<2 node always rejects).  Conditioned on acceptance the pair is
+    exactly uniform over ordered distinct neighbor pairs — the reference's
+    distribution.  Documented deviation: anchors are swept once per draw
+    (every node appears ceil(L/N) times) instead of L independent uniform
+    node draws; the first ``n_samples`` of the draw grid are kept.
+
+    Priorities are content-keyed (hash of (u, v, salt), as
+    segment.pair_jitter) so auto-growth replay reproduces the identical
+    wedges (graph.grow_slab's result-preservation contract).
+    """
+    from fastconsensus_tpu.ops import segment as seg
+
+    n = slab.n_nodes
+    srcd, dstd, _, ad = slab.directed()
+    valid_e = ad & (srcd != dstd)
+    draws = -(-n_samples // max(n, 1))
+
+    def partner(k: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        pri = seg.pair_jitter(k, srcd, dstd, 1.0)
+        best, _, has = seg.scatter_argmax_label(srcd, pri, dstd, valid_e, n)
+        return best, has
+
+    def draw(_, d):
+        # lax.scan keeps program size O(1) in the draw count (an unrolled
+        # loop compiles ceil(L/N) scatter-argmax pairs into the round
+        # executable — on dense graphs that blew up tunnel compiles)
+        k1, k2 = jax.random.split(jax.random.fold_in(key, d))
+        p1, h1 = partner(k1)
+        p2, h2 = partner(k2)
+        ok = h1 & h2 & (p1 != p2)
+        return None, (jnp.minimum(p1, p2), jnp.maximum(p1, p2), ok)
+
+    _, (us, vs, oks) = jax.lax.scan(draw, None,
+                                    jnp.arange(draws, dtype=jnp.int32))
+    u = us.reshape(-1)[:n_samples]
+    v = vs.reshape(-1)[:n_samples]
+    ok = oks.reshape(-1)[:n_samples]
+    return jnp.where(ok, u, 0), jnp.where(ok, v, 0), ok
+
+
+def insert_edges_hash(slab: GraphSlab,
+                      cand_u: jax.Array,
+                      cand_v: jax.Array,
+                      cand_w: jax.Array,
+                      cand_valid: jax.Array,
+                      unique_new: bool = False
+                      ) -> Tuple[GraphSlab, jax.Array]:
+    """Sort-free :func:`insert_edges`: hash-table dedup + prefix-sum slots.
+
+    Replaces the global lexsort over (capacity + k) entries — which under
+    an edge-sharded mesh re-gathers the slab — with O(E + k) scatters:
+
+    * existing-edge membership: the two-table scheme of
+      segment.HashTables over the alive canonical (u, v) pairs.  A
+      candidate whose pair is present reads > 0 in both tables
+      (no false negatives, so a duplicate edge can never be inserted); an
+      absent pair collides in both tables with probability ~(E/B)^2 and is
+      then dropped — closure candidates are random samples, so a rare
+      false drop is sampling noise, not an error.
+    * candidate-vs-candidate dedup: scatter-min of the candidate index
+      into two tag tables; a candidate survives if it holds the minimum in
+      either bucket.  Duplicate candidates share both buckets, so exactly
+      the first occurrence survives (the lexsort rule); two *distinct*
+      candidates drop one only on a double collision (~(k/B)^2).
+    * free slots: prefix-sum rank over dead slots + one scatter — the same
+      slot order as argsort(alive, stable), preserving grow_slab's
+      result-preservation contract.
+
+    Table sizes derive from the growth-stable cap hint, so auto-growth
+    replays identically.
+
+    ``unique_new=True`` declares the candidates already pairwise-distinct
+    and absent from the slab (singleton repair guarantees both —
+    singleton_candidates); membership and dedup are skipped entirely, so
+    such candidates are EXACT — a repair edge must never be lost to a
+    hash collision.
+    """
+    from fastconsensus_tpu.models.louvain import _cap_hint
+    from fastconsensus_tpu.ops import segment as seg
+
+    cap = slab.capacity
+    k = cand_u.shape[0]
+    n = slab.n_nodes
+    cu = cand_u.astype(jnp.int32)
+    cv = cand_v.astype(jnp.int32)
+
+    if unique_new:
+        surv = cand_valid
+    else:
+        # existing-edge membership (presence sums over canonical pairs)
+        b_e = seg.hash_buckets_for(_cap_hint(slab))
+        tables = seg.build_hash_totals(
+            slab.src, slab.dst, jnp.ones((cap,), jnp.float32), slab.alive,
+            b_e)
+        exists = seg.lookup_hash_totals(tables, cu, cv) > 0.0
+
+        # first-occurrence-wins dedup among the candidates themselves
+        b_c = seg.hash_buckets_for(k)
+        h1 = seg._hash_mix(cu, cv, 0x9E3779B1, 0x85EBCA77, b_c)
+        h2 = seg._hash_mix(cu, cv, 0x27D4EB2F, 0x165667B1, b_c)
+        tag = jnp.arange(k, dtype=jnp.int32)
+        live = cand_valid & ~exists
+        big = jnp.int32(k)
+        t1 = jnp.full((b_c + 1,), big, jnp.int32).at[
+            jnp.where(live, h1, b_c)].min(tag, mode="drop")
+        t2 = jnp.full((b_c + 1,), big, jnp.int32).at[
+            jnp.where(live, h2, b_c)].min(tag, mode="drop")
+        surv = live & ((t1[h1] == tag) | (t2[h2] == tag))
+
+    # free-slot assignment: rank dead slots in slot order (prefix sum),
+    # then invert rank -> slot with one scatter
+    dead = ~slab.alive
+    rank_dead = jnp.cumsum(dead.astype(jnp.int32)) - 1
+    free_slots = jnp.full((cap,), cap, jnp.int32).at[
+        jnp.where(dead, rank_dead, cap)].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    n_free = jnp.sum(dead.astype(jnp.int32))
+    rank = jnp.cumsum(surv.astype(jnp.int32)) - 1
+    ok = surv & (rank < n_free)
+    slot = jnp.where(ok, free_slots[jnp.clip(rank, 0, cap - 1)], cap)
+
+    src = slab.src.at[slot].set(cu, mode="drop")
+    dst = slab.dst.at[slot].set(cv, mode="drop")
+    weight = slab.weight.at[slot].set(cand_w.astype(jnp.float32),
+                                      mode="drop")
+    alive = slab.alive.at[slot].set(True, mode="drop")
+    n_dropped = jnp.sum(surv.astype(jnp.int32)) - \
+        jnp.sum(ok.astype(jnp.int32))
+    new_slab = dataclasses.replace(slab, src=src, dst=dst, weight=weight,
+                                   alive=alive)
+    return new_slab, n_dropped
+
+
 def insert_edges(slab: GraphSlab,
                  cand_u: jax.Array,
                  cand_v: jax.Array,
@@ -204,6 +350,15 @@ def singleton_candidates(slab: GraphSlab, prev: GraphSlab
 
     nodes = jnp.arange(n, dtype=jnp.int32)
     valid = isolated & (partner >= 0)
+    # Exact self-dedup: two isolated nodes that pick each other both
+    # propose the same canonical pair — keep the lower node's proposal.
+    # With this, repair candidates are UNIQUE and (one endpoint being
+    # isolated) cannot already exist in the slab, so the insert may take
+    # the exact unique_new path: a repair must never be lost to a hash
+    # collision (the reference guarantees reattachment, fc:193-195).
+    p_c = jnp.clip(partner, 0, n - 1)
+    mutual = valid & (partner < nodes) & valid[p_c] & (partner[p_c] == nodes)
+    valid = valid & ~mutual
     u = jnp.minimum(nodes, partner)
     v = jnp.maximum(nodes, partner)
     w = jnp.where(jnp.isfinite(best_w), best_w, 0.0)

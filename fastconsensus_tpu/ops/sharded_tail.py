@@ -1,0 +1,348 @@
+"""Edge-local consensus tail: the round's post-detection phases under
+``jax.shard_map``.
+
+Round 2 sharded the edge slab over the mesh's ``"e"`` axis but left the
+tail (co-membership -> threshold -> convergence -> closure -> repair) to
+GSPMD, whose partitioning of the tail's sorts, concatenates and scatters
+re-gathers the whole slab onto every device — 19-20 capacity-sized
+all-gathers per round (parallel/sharding.py module notes; pinned in
+tests/test_parallel.py).  The axis sharded *storage* without reducing the
+round's peak *working* memory, which is the reason it exists (SURVEY.md
+§2.24: the 100k-edge-and-up configs).
+
+This module instead writes the tail the explicit SPMD way: every phase is
+a per-shard computation over the device's LOCAL slab chunk, communicating
+only
+
+* ``psum("p")`` of per-edge agreement counts (the co-membership
+  contraction — the round's one inherent collective),
+* ``psum``/``pmax("e")`` of node-indexed ``[N]`` vectors (degrees,
+  random-partner priorities, strongest-previous-neighbor),
+* ``psum("e")`` of the hash membership tables and of scalar stats,
+* one tiny ``all_gather("e")`` of per-shard free-slot counts.
+
+The slab's raw per-edge arrays never cross the interconnect; the largest
+remaining collectives are the two membership tables of the closure insert
+(~4x the edge-count in buckets — proportional to graph size but
+independent of the shard count; kept global rather than per-shard-OR'ed
+so sharded and unsharded insertion see the identical collision pattern).
+Every reduction is integer-valued (counts, psums of 0/1) or order-free
+(max), so the sharded tail is **bit-identical** to
+:func:`consensus.consensus_tail` on the same inputs — asserted by
+tests/test_parallel.py parity tests.
+
+Reference context: the whole tail replaces ``fast_consensus.py:150-195``
+(dict loops on one process); the reference has no distributed story at
+all, so this file is where the framework's edge-scale axis becomes real.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from fastconsensus_tpu.graph import GraphSlab
+from fastconsensus_tpu.ops import segment as seg
+
+# axis names must match parallel/sharding.py (imported lazily there to
+# avoid a cycle; the literals are part of the mesh contract)
+ENSEMBLE_AXIS = "p"
+EDGE_AXIS = "e"
+
+
+def _node_psum(vals: jax.Array, segs: jax.Array, valid: jax.Array,
+               n: int) -> jax.Array:
+    """Cross-shard segment-sum into a replicated [n] vector (int/exact)."""
+    s = jnp.where(valid, segs, n)
+    local = jnp.zeros((n + 1,), vals.dtype).at[s].add(
+        jnp.where(valid, vals, jnp.zeros((), vals.dtype)), mode="drop")[:-1]
+    return jax.lax.psum(local, EDGE_AXIS)
+
+
+def _node_argmax(score: jax.Array, segs: jax.Array, label: jax.Array,
+                 valid: jax.Array, n: int
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Cross-shard :func:`segment.scatter_argmax_label`: per node, the
+    label of the globally max-score entry, ties toward the larger label —
+    the same rule as the unsharded op, realized as two pmax passes."""
+    neg_inf = jnp.float32(-jnp.inf)
+    s = jnp.where(valid, segs, n).astype(jnp.int32)
+    masked = jnp.where(valid, score, neg_inf)
+    best_local = jnp.full((n + 1,), neg_inf).at[s].max(
+        masked, mode="drop")[:-1]
+    best = jax.lax.pmax(best_local, EDGE_AXIS)
+    is_best = valid & (masked == best[jnp.clip(s, 0, n - 1)]) & (s < n)
+    lab_local = jnp.full((n + 1,), -1, jnp.int32).at[
+        jnp.where(is_best, s, n)].max(
+        jnp.where(is_best, label, -1), mode="drop")[:-1]
+    lab = jax.lax.pmax(lab_local, EDGE_AXIS)
+    has = jnp.isfinite(best)
+    return jnp.where(has, lab, -1), jnp.where(has, best, neg_inf), has
+
+
+def _degrees(slab: GraphSlab) -> jax.Array:
+    """Replicated alive-degree [n] from the local shard (graph.degrees)."""
+    n = slab.n_nodes
+    ones = jnp.ones((slab.capacity,), jnp.int32)
+    return _node_psum(ones, slab.src, slab.alive, n) + \
+        _node_psum(ones, slab.dst, slab.alive, n)
+
+
+def _comembership(labels: jax.Array, u: jax.Array, v: jax.Array
+                  ) -> jax.Array:
+    """Partition-agreement counts, contracted over the ensemble axis."""
+    agree = labels[:, u] == labels[:, v]
+    return jax.lax.psum(jnp.sum(agree, axis=0, dtype=jnp.float32),
+                        ENSEMBLE_AXIS)
+
+
+def _conv_stats(slab: GraphSlab, n_p: int, delta: float):
+    mid = slab.alive & (slab.weight > 0) & \
+        (slab.weight < jnp.float32(n_p))
+    n_mid = jax.lax.psum(jnp.sum(mid.astype(jnp.int32)), EDGE_AXIS)
+    n_alive = jax.lax.psum(jnp.sum(slab.alive.astype(jnp.int32)),
+                           EDGE_AXIS)
+    converged = n_mid.astype(jnp.float32) <= jnp.float32(delta) * \
+        n_alive.astype(jnp.float32)
+    return converged, n_mid, n_alive
+
+
+def _num_alive(slab: GraphSlab) -> jax.Array:
+    return jax.lax.psum(jnp.sum(slab.alive.astype(jnp.int32)), EDGE_AXIS)
+
+
+def _sample_wedges(key: jax.Array, slab: GraphSlab, n_samples: int):
+    """consensus_ops.sample_wedges_scatter with the partner argmax taken
+    across shards (same content-keyed priorities => same winners)."""
+    n = slab.n_nodes
+    srcd = jnp.concatenate([slab.src, slab.dst])  # local concat: no comm
+    dstd = jnp.concatenate([slab.dst, slab.src])
+    ad = jnp.concatenate([slab.alive, slab.alive])
+    valid_e = ad & (srcd != dstd)
+    draws = -(-n_samples // max(n, 1))
+
+    def partner(k):
+        pri = seg.pair_jitter(k, srcd, dstd, 1.0)
+        best, _, has = _node_argmax(pri, srcd, dstd, valid_e, n)
+        return best, has
+
+    def draw(_, d):
+        # lax.scan, not an unrolled loop: program size stays O(1) in the
+        # draw count (mirrors consensus_ops.sample_wedges_scatter)
+        k1, k2 = jax.random.split(jax.random.fold_in(key, d))
+        p1, h1 = partner(k1)
+        p2, h2 = partner(k2)
+        ok = h1 & h2 & (p1 != p2)
+        return None, (jnp.minimum(p1, p2), jnp.maximum(p1, p2), ok)
+
+    _, (us, vs, oks) = jax.lax.scan(draw, None,
+                                    jnp.arange(draws, dtype=jnp.int32))
+    u = us.reshape(-1)[:n_samples]
+    v = vs.reshape(-1)[:n_samples]
+    ok = oks.reshape(-1)[:n_samples]
+    return jnp.where(ok, u, 0), jnp.where(ok, v, 0), ok
+
+
+def _insert_edges(slab: GraphSlab, cand_u, cand_v, cand_w, cand_valid,
+                  cap_hint: int, unique_new: bool = False):
+    """consensus_ops.insert_edges_hash with shard-local tables and slots.
+
+    Membership tables are psum("e")-combined (sums of ones — exact);
+    candidate dedup is computed identically on every shard (candidates are
+    replicated); free slots are assigned in GLOBAL slot order — shard s
+    owns the contiguous chunk [s*cap_local, (s+1)*cap_local), matching the
+    unsharded argsort(alive)-equivalent order bit-exactly — and each
+    survivor is written by exactly the shard owning its slot.
+    """
+    cap_l = slab.capacity
+    k = cand_u.shape[0]
+    cu = cand_u.astype(jnp.int32)
+    cv = cand_v.astype(jnp.int32)
+
+    if unique_new:
+        # singleton repair: candidates are pairwise-distinct and absent
+        # from the slab by construction — exact, no hash involvement
+        surv = cand_valid
+    else:
+        # existing-edge membership (canonical pairs, two-table scheme)
+        b_e = seg.hash_buckets_for(cap_hint)
+        h1e = seg._hash_mix(slab.src, slab.dst, 0x9E3779B1, 0x85EBCA77,
+                            b_e)
+        h2e = seg._hash_mix(slab.src, slab.dst, 0x27D4EB2F, 0x165667B1,
+                            b_e)
+        one = jnp.ones((cap_l,), jnp.float32)
+        t1 = jax.lax.psum(jnp.zeros((b_e + 1,), jnp.float32).at[
+            jnp.where(slab.alive, h1e, b_e)].add(one, mode="drop"),
+            EDGE_AXIS)
+        t2 = jax.lax.psum(jnp.zeros((b_e + 1,), jnp.float32).at[
+            jnp.where(slab.alive, h2e, b_e)].add(one, mode="drop"),
+            EDGE_AXIS)
+        h1c = seg._hash_mix(cu, cv, 0x9E3779B1, 0x85EBCA77, b_e)
+        h2c = seg._hash_mix(cu, cv, 0x27D4EB2F, 0x165667B1, b_e)
+        exists = jnp.minimum(t1[h1c], t2[h2c]) > 0.0
+
+        # first-occurrence dedup among candidates (replicated computation)
+        b_c = seg.hash_buckets_for(k)
+        g1 = seg._hash_mix(cu, cv, 0x9E3779B1, 0x85EBCA77, b_c)
+        g2 = seg._hash_mix(cu, cv, 0x27D4EB2F, 0x165667B1, b_c)
+        tag = jnp.arange(k, dtype=jnp.int32)
+        live = cand_valid & ~exists
+        big = jnp.int32(k)
+        d1 = jnp.full((b_c + 1,), big, jnp.int32).at[
+            jnp.where(live, g1, b_c)].min(tag, mode="drop")
+        d2 = jnp.full((b_c + 1,), big, jnp.int32).at[
+            jnp.where(live, g2, b_c)].min(tag, mode="drop")
+        surv = live & ((d1[g1] == tag) | (d2[g2] == tag))
+
+    # global free-slot assignment
+    dead = ~slab.alive
+    local_free_count = jnp.sum(dead.astype(jnp.int32))
+    counts = jax.lax.all_gather(local_free_count, EDGE_AXIS)  # [n_shards]
+    me = jax.lax.axis_index(EDGE_AXIS)
+    offset = jnp.sum(jnp.where(
+        jnp.arange(counts.shape[0]) < me, counts, 0))
+    n_free = jax.lax.psum(local_free_count, EDGE_AXIS)
+    rank = jnp.cumsum(surv.astype(jnp.int32)) - 1
+    ok = surv & (rank < n_free)
+    mine = ok & (rank >= offset) & (rank < offset + local_free_count)
+    local_rank = jnp.cumsum(dead.astype(jnp.int32)) - 1
+    local_free = jnp.full((cap_l,), cap_l, jnp.int32).at[
+        jnp.where(dead, local_rank, cap_l)].set(
+        jnp.arange(cap_l, dtype=jnp.int32), mode="drop")
+    lslot = jnp.where(mine, local_free[jnp.clip(rank - offset, 0,
+                                                cap_l - 1)], cap_l)
+
+    import dataclasses
+
+    new_slab = dataclasses.replace(
+        slab,
+        src=slab.src.at[lslot].set(cu, mode="drop"),
+        dst=slab.dst.at[lslot].set(cv, mode="drop"),
+        weight=slab.weight.at[lslot].set(cand_w.astype(jnp.float32),
+                                         mode="drop"),
+        alive=slab.alive.at[lslot].set(True, mode="drop"))
+    n_dropped = jnp.sum(surv.astype(jnp.int32)) - \
+        jnp.sum(ok.astype(jnp.int32))
+    return new_slab, n_dropped
+
+
+def _singleton_candidates(slab: GraphSlab, prev: GraphSlab):
+    """consensus_ops.singleton_candidates with cross-shard reductions."""
+    n = slab.n_nodes
+    isolated = _degrees(slab) == 0
+
+    psrc = jnp.concatenate([prev.src, prev.dst])
+    pdst = jnp.concatenate([prev.dst, prev.src])
+    pw = jnp.concatenate([prev.weight, prev.weight])
+    pad = jnp.concatenate([prev.alive, prev.alive])
+    pseg = jnp.where(pad, psrc, n)
+    neg_inf = jnp.float32(-jnp.inf)
+    bw_local = jnp.full((n + 1,), neg_inf).at[pseg].max(
+        jnp.where(pad, pw, neg_inf), mode="drop")[:-1]
+    best_w = jax.lax.pmax(bw_local, EDGE_AXIS)
+    at_best = pad & (pw == best_w[jnp.clip(pseg, 0, n - 1)]) & (pseg < n)
+    partner_local = jnp.full((n + 1,), -1, jnp.int32).at[
+        jnp.where(at_best, pseg, n)].max(
+        jnp.where(at_best, pdst, -1), mode="drop")[:-1]
+    partner = jax.lax.pmax(partner_local, EDGE_AXIS)
+
+    nodes = jnp.arange(n, dtype=jnp.int32)
+    valid = isolated & (partner >= 0)
+    # exact self-dedup of mutual pairs (consensus_ops.singleton_candidates)
+    p_c = jnp.clip(partner, 0, n - 1)
+    mutual = valid & (partner < nodes) & valid[p_c] & \
+        (partner[p_c] == nodes)
+    valid = valid & ~mutual
+    u = jnp.minimum(nodes, partner)
+    v = jnp.maximum(nodes, partner)
+    w = jnp.where(jnp.isfinite(best_w), best_w, 0.0)
+    return jnp.where(valid, u, 0), jnp.where(valid, v, 0), w, valid
+
+
+def _tail_local(slab: GraphSlab, labels: jax.Array, k_closure: jax.Array,
+                *, n_p: int, tau: float, delta: float, n_closure: int,
+                cap_hint: int, hybrid_gate: bool):
+    """The per-shard tail program; see the module docstring."""
+    from fastconsensus_tpu.consensus import RoundStats
+
+    from fastconsensus_tpu.ops import consensus_ops as cops
+
+    n = slab.n_nodes
+    counts = _comembership(labels, slab.src, slab.dst)
+    prev = slab
+    # purely elementwise over the local chunk: the unsharded ops apply
+    # verbatim (single source for the skip-converged-edges rule)
+    slab = cops.update_weights(slab, counts, n_p)
+    slab = cops.threshold_weights(slab, tau, n_p)
+    mid_converged, mid_n_mid, mid_n_alive = _conv_stats(slab, n_p, delta)
+
+    def do_closure(slab):
+        n0 = _num_alive(slab)
+        cu, cv, cvalid = _sample_wedges(k_closure, slab, n_closure)
+        cw = _comembership(labels, cu, cv)
+        slab, dropped = _insert_edges(slab, cu, cv, cw, cvalid, cap_hint)
+        n1 = _num_alive(slab)
+        su, sv, sw, svalid = _singleton_candidates(slab, prev)
+        slab, dropped2 = _insert_edges(slab, su, sv, sw, svalid, cap_hint,
+                                       unique_new=True)
+        return slab, n1 - n0, _num_alive(slab) - n1, dropped + dropped2
+
+    def skip_closure(slab):
+        return slab, jnp.int32(0), jnp.int32(0), jnp.int32(0)
+
+    slab, n_closed, n_repaired, n_dropped = jax.lax.cond(
+        mid_converged, skip_closure, do_closure, slab)
+    end_converged, end_n_mid, end_n_alive = _conv_stats(slab, n_p, delta)
+    deg = _degrees(slab)
+    if slab.d_cap > 0:
+        n_overflow = jnp.sum(
+            jnp.maximum(deg - slab.d_cap, 0).astype(jnp.int32))
+    else:
+        n_overflow = jnp.int32(0)
+    if hybrid_gate:
+        hub_mass = jnp.sum(jnp.where(deg > slab.d_hyb, deg, 0)
+                           .astype(jnp.int32))
+        n_hub_overflow = jnp.maximum(hub_mass - slab.hub_cap, 0)
+    else:
+        n_hub_overflow = jnp.int32(0)
+    stats = RoundStats(
+        converged=mid_converged | end_converged,
+        n_alive=end_n_alive,
+        n_unconverged=end_n_mid,
+        n_closure_added=n_closed,
+        n_repaired=n_repaired,
+        n_dropped=n_dropped,
+        n_overflow=n_overflow,
+        n_hub_overflow=n_hub_overflow,
+        cold=jnp.bool_(False),
+    )
+    return slab, stats
+
+
+def sharded_consensus_tail(slab: GraphSlab, labels: jax.Array,
+                           k_closure: jax.Array, n_p: int, tau: float,
+                           delta: float, n_closure: int, mesh
+                           ) -> Tuple[GraphSlab, "object"]:
+    """Run the tail edge-locally over ``mesh`` (axes "p" x "e").
+
+    In/out shardings: slab leaves split over "e", labels over "p", stats
+    replicated.  Bit-identical to :func:`consensus.consensus_tail` (see
+    module docstring); with a 1-sized edge axis every "e" collective is a
+    no-op and only the co-membership psum("p") remains.
+    """
+    from fastconsensus_tpu.models.louvain import _cap_hint, select_move_path
+
+    fn = jax.shard_map(
+        functools.partial(
+            _tail_local, n_p=n_p, tau=tau, delta=delta,
+            n_closure=n_closure, cap_hint=_cap_hint(slab),
+            hybrid_gate=select_move_path(slab) == "hybrid"),
+        mesh=mesh,
+        in_specs=(P(EDGE_AXIS), P(ENSEMBLE_AXIS, None), P()),
+        out_specs=(P(EDGE_AXIS), P()),
+        check_vma=False)
+    return fn(slab, labels, k_closure)

@@ -13,16 +13,17 @@ insert the collectives:
   one ``psum`` over ICI — the only communication in the whole round.
 * **edge axis ``"e"`` (the SP/TP analog)** — the COO slab itself shards
   along capacity, distributing the *resident* graph across chips' HBM.
-  Measured caveat (round 2, 120k-edge HLO inspection on a p=4 x e=2 mesh):
-  XLA's partitioner keeps simple segment reductions sharded, but the
-  round's sort-based ops (CSR build for wedge sampling, insert-dedup
-  lexsort) need a global order and re-gather the slab — 19 capacity-sized
-  all-gathers per *round* (not per detection sweep; sweeps run on
-  per-detection layouts built once).  That is cheap through ~10^7 edges
-  (MBs per round) but means the edge axis does not yet reduce peak
-  *working* memory for the round step itself; sort-free reformulations of
-  closure/dedup are the known path to true edge-local compute
-  (tests/test_parallel.py pins today's behavior).
+  The consensus tail runs edge-LOCAL under an explicit ``jax.shard_map``
+  (ops/sharded_tail.py): co-membership, thresholding, convergence,
+  sort-free wedge sampling, hash-dedup insertion and singleton repair all
+  operate on each device's local chunk, communicating [N]-sized node
+  vectors, the closure insert's hash tables (edge-count-proportional but
+  shard-count-independent), and scalars — the slab's per-edge arrays
+  never cross the interconnect, and results are bit-identical to the
+  unsharded tail
+  (round-2's GSPMD tail re-gathered the slab 19x per round; measured
+  round 3: 5 slab-sized all-gathers remain, all inside the detection's
+  own per-call layout builds — tests/test_parallel.py pins this).
 
 No hand-rolled communication backend exists or is needed (the reference has
 none either): `jit` + `NamedSharding` over the mesh IS the distributed

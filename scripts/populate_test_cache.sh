@@ -1,0 +1,14 @@
+#!/bin/bash
+# Populate the suite's persistent XLA compile cache one test file per
+# process.  Compiling the whole suite's kernels in ONE process has
+# segfaulted XLA:CPU on some hosts (cumulative JIT state); per-file
+# processes keep each compile session small, and later whole-suite runs
+# hit the cache instead of compiling.  Safe to re-run; also the fix when
+# the cache is suspected stale: clear /tmp/fctpu_jax_cache_* first.
+set -e
+cd "$(dirname "$0")/.."
+for f in tests/test_*.py; do
+  echo "== $f"
+  python -m pytest "$f" -q -m "not slow" || exit 1
+done
+echo "cache populated"

@@ -15,8 +15,32 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-# Persistent compile cache: the suite re-jits the same kernels every run.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/fctpu_jax_cache")
+# Persistent compile cache, keyed by a host-CPU fingerprint: an XLA:CPU
+# AOT executable loaded on a host with different CPU features can ABORT
+# the process (observed: a cache dir shared across machines through this
+# container image crashed the suite inside
+# compilation_cache.get_executable).  The cache also sidesteps an
+# XLA:CPU compiler segfault seen when one process compiles the whole
+# suite's kernels back-to-back (cache hits skip those compiles entirely;
+# populate a fresh cache with scripts/populate_test_cache.sh, which runs
+# one process per test file).
+import hashlib  # noqa: E402
+
+
+def _host_tag() -> str:
+    # keep in sync with bench.py:_host_tag — both must run BEFORE any jax
+    # import, and every fastconsensus_tpu module imports jax, so a shared
+    # helper module cannot host this
+    try:
+        with open("/proc/cpuinfo") as fh:
+            flags = next(line for line in fh if line.startswith("flags"))
+        return hashlib.sha1(flags.encode()).hexdigest()[:8]
+    except (OSError, StopIteration):
+        return "generic"
+
+
+os.environ["JAX_COMPILATION_CACHE_DIR"] = \
+    f"/tmp/fctpu_jax_cache_{_host_tag()}"
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 # No persisted rate calibration under test (utils/calibrate.py): rates
@@ -50,6 +74,22 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: slow statistical / integration tests")
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _release_jax_executables():
+    """Drop compiled executables after each test module.
+
+    One pytest process compiling/loading the whole suite's kernels
+    accumulates ~65k memory maps and ABORTS at the kernel's default
+    vm.max_map_count (65530) — measured: the process died at 64,763 maps,
+    always ~64 tests in.  Executables a later module re-needs reload from
+    the persistent compile cache, so this costs little.
+    """
+    yield
+    import jax
+
+    jax.clear_caches()
 
 
 @pytest.fixture(scope="session")

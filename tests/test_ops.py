@@ -138,6 +138,62 @@ def test_insert_edges_dedup_and_capacity():
     assert (0, 2, 8.0) in got
 
 
+def test_insert_edges_hash_matches_lexsort_oracle():
+    """The sort-free insert (consensus_tail's production path) must agree
+    with the exact lexsort oracle whenever no hash collision occurs — and
+    at these table load factors (<= 0.25 squared) collisions are absent on
+    this deterministic input."""
+    rng = np.random.default_rng(3)
+    edges, _ = __import__(
+        "fastconsensus_tpu.utils.synth", fromlist=["synth"]
+    ).planted_partition(60, 4, 0.3, 0.05, seed=3)
+    slab = pack_edges(edges, 60)
+    # kill a third of the edges so there are free slots and live dedup
+    alive = np.asarray(slab.alive).copy()
+    kill = rng.random(alive.shape) < 0.33
+    slab = slab.with_weights(slab.weight, alive=jnp.asarray(alive & ~kill))
+    k = 80
+    cu = rng.integers(0, 60, k)
+    cv = rng.integers(0, 60, k)
+    u = np.minimum(cu, cv).astype(np.int64)
+    v = np.maximum(cu, cv).astype(np.int64)
+    valid = u != v
+    w = rng.random(k).astype(np.float32)
+    # seed duplicates of existing edges and of other candidates
+    u[:5], v[:5] = np.asarray(slab.src)[:5], np.asarray(slab.dst)[:5]
+    u[5:8], v[5:8] = u[10:13], v[10:13]
+
+    a, da = cops.insert_edges(slab, jnp.asarray(u), jnp.asarray(v),
+                              jnp.asarray(w), jnp.asarray(valid))
+    b, db = cops.insert_edges_hash(slab, jnp.asarray(u), jnp.asarray(v),
+                                   jnp.asarray(w), jnp.asarray(valid))
+    ea = sorted(zip(*[x.tolist() for x in host_edges(a)]))
+    eb = sorted(zip(*[x.tolist() for x in host_edges(b)]))
+    assert ea == eb
+    assert int(da) == int(db)
+    # exactness invariant regardless of collisions: no duplicate pairs
+    eu, ev, _ = host_edges(b)
+    pairs = list(zip(eu.tolist(), ev.tolist()))
+    assert len(pairs) == len(set(pairs))
+
+
+def test_sample_wedges_scatter_produces_real_wedges():
+    edges = np.array([[0, 1], [0, 2], [0, 3], [1, 2], [3, 4]])
+    slab = pack_edges(edges, 5)
+    u, v, valid = cops.sample_wedges_scatter(jax.random.key(1), slab, 64)
+    u, v, valid = np.asarray(u), np.asarray(v), np.asarray(valid)
+    adj = {i: set() for i in range(5)}
+    for a, b in edges:
+        adj[a].add(b)
+        adj[b].add(a)
+    assert valid.any()
+    for i in range(64):
+        if valid[i]:
+            assert u[i] < v[i]
+            # endpoints share at least one common neighbor (the anchor)
+            assert adj[u[i]] & adj[v[i]], (u[i], v[i])
+
+
 def test_singleton_repair():
     # prev graph: 0-1 (w 2), 0-2 (w 7); current: only 1-2 alive, 0 isolated
     prev = pack_edges(np.array([[0, 1], [0, 2], [1, 2]]), 3,
