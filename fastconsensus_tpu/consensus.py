@@ -85,17 +85,20 @@ class ConsensusConfig:
     # for rounds (round-1: 5 rounds on planted-100k vs 1 for the
     # near-deterministic CPU reference).  Only active with warm_start
     # (aligned COLD members would be identical clones — a single run in
-    # disguise); the diversity that builds the consensus signal comes from
-    # the independent rounds before the threshold.  The final re-detection
-    # is never aligned, and the singleton-start round never aligns.  Fused
-    # round blocks re-derive the flag per round from their own stats, so
-    # fused and per-round execution stay bit-identical.  Detectors without
+    # disguise); the independent singleton-start round provides the
+    # ensemble's diversity, and members keep their label-structure
+    # differences through aligned rounds.  The final re-detection is never
+    # aligned, and the singleton-start round never aligns.  Fused round
+    # blocks re-derive the flag per round from their own stats, so fused
+    # and per-round execution stay bit-identical.  Detectors without
     # content-keyed tie-breaks (supports_align unset: lpm, native
-    # cnm/infomap) ignore it.  0 disables.  Default 0.4: the ambiguous
-    # configs plateau at unconverged fractions around 0.3-0.4 (lfr10k
-    # mu=0.5 measured round 3) — a threshold below the plateau never
-    # engages exactly where alignment is needed most.
-    align_frac: float = 0.4
+    # cnm/infomap) ignore it.  0 disables.  Default 1.0 — align EVERY
+    # warm round: measured head-to-head on lfr10k/leiden (BASELINE.md
+    # round 3), full alignment held consensus quality at the cold
+    # engine's level (NMI 0.524 vs 0.525) while threshold-0.4 alignment,
+    # which lets members accumulate uncorrelated densification noise for
+    # the first rounds, ended at 0.482.
+    align_frac: float = 1.0
 
 
 class RoundStats(NamedTuple):
