@@ -191,20 +191,54 @@ def consensus_tail(slab: GraphSlab,
     return slab, stats
 
 
-def _stall_floor(delta: float, n_alive) -> jnp.float32:
-    """Minimum mid-weight edge count for the stagnation rule to apply.
+# Rounds without a strict new unconverged-FRACTION minimum before the
+# stale refresh fires (see _stall_floor / _stale_state).
+_STALE_ROUNDS = 4
+
+
+def _stall_floor(delta: float, n_alive, absolute: float) -> jnp.float32:
+    """Minimum mid-weight edge count for a stagnation rule to apply.
 
     A 10%-relative rule alone misfires at endgame granularity (12 -> 11
     unconverged is an 8% "stall") and near the convergence bar, where a
-    cold restart would blow away nearly-converged state the alignment
-    endgame is about to finish.  Stagnation therefore requires the count
-    to still sit at >= 4x the ``delta`` convergence bar AND >= 64
-    absolute (delta=0 runs).  f32 arithmetic, shared bit-exactly by the
-    host (run_consensus.stalled) and the fused block.
+    cold restart would blow away nearly-converged state.  Stagnation
+    therefore requires the count to still sit at >= 4x the ``delta``
+    convergence bar AND >= ``absolute`` (delta=0 runs).  The one-step
+    rule keeps 64 (it guards unaligned endgames grinding through small
+    counts); the stale/limit-cycle rule uses 16 — tiny graphs' whole
+    mid-weight band is ~30 edges (karate) and a 64 floor silently
+    disabled every refresh there, measured: a warm limit cycle ground 64
+    rounds.  f32 arithmetic, shared bit-exactly by the host
+    (run_consensus) and the fused block.
     """
     bar = jnp.float32(4.0) * jnp.float32(delta) * \
         jnp.asarray(n_alive, jnp.float32)
-    return jnp.maximum(jnp.float32(64.0), bar)
+    return jnp.maximum(jnp.float32(absolute), bar)
+
+
+def _stale_state(history) -> Tuple[float, int]:
+    """(minimum unconverged FRACTION since the last cold round, rounds
+    since that minimum last improved) — the incremental form both the host
+    loop and the fused block maintain.  Catches warm LIMIT CYCLES: an
+    ensemble can oscillate (measured on karate: 26 -> 34 -> 28 -> 31 ->
+    ... for 64 rounds) without ever tripping the one-step 10% rule, and
+    alignment does not break the cycle — only a cold refresh does, so the
+    stale rule fires even on aligned rounds.  The FRACTION (not the
+    count) is tracked so healthy densifying runs — whose absolute
+    mid-weight count grows with the graph while the fraction falls
+    monotonically (lfr10k 0.97 -> 0.24, lfr100k 0.94 -> 0.55, measured)
+    — never trigger the refresh that would re-randomize them.  np/jnp
+    float32 division on both sides keeps host and fused block bit-exact.
+    """
+    m, s = np.float32(2.0), 0
+    for h in history:
+        frac = np.float32(h["n_unconverged"]) / \
+            np.float32(max(h["n_alive"], 1))
+        if h.get("cold") or frac < m:
+            m, s = frac, 0
+        else:
+            s += 1
+    return float(m), s
 
 
 def _maybe_align_keys(keys: jax.Array, align) -> jax.Array:
@@ -318,6 +352,8 @@ def consensus_rounds_block(slab: GraphSlab,
                            max_iters: jax.Array,
                            align0: jax.Array,
                            unconv0: jax.Array,
+                           mfrac0: jax.Array,
+                           scount0: jax.Array,
                            detect: Detector,
                            detect_warm: Detector,
                            detect_refresh: Detector,
@@ -359,17 +395,20 @@ def consensus_rounds_block(slab: GraphSlab,
     driver passes 0 for detectors without content-keyed tie-breaks).
 
     ``unconv0`` (traced int32[3] = [u_prev2, u_prev1, alive_prev1], -1 =
-    unknown) is the stagnation state entering the block: a warm round that
-    fails to shrink the mid-weight edge count by >= 10% — while that count
-    is still far above the convergence bar (``_stall_floor``) — marks the
-    run *stagnated*, and the next round re-detects COLD: singleton init,
-    the full-sweep base detector, independent keys.  This restores the
-    cold engine's convergence pressure when warm members lock into diverse
-    local optima (measured round 3: warm leiden on lfr10k never converges
-    — the consensus graph grows ~30k edges/round while disagreement
-    persists).  A cold round resets the state (its own fresh disagreement
-    must not immediately re-trigger), so warm rounds resume from the
-    refreshed labels.  Same f32 rule as the driver's ``stalled()``.
+    unknown), ``mfrac0`` (traced f32: minimum unconverged fraction since
+    the last cold round) and ``scount0`` (traced int32: rounds since that
+    minimum improved) are the stagnation state entering the block: a warm
+    UNALIGNED round that fails to shrink the mid-weight edge count by
+    >= 10% — or ANY warm round when the unconverged FRACTION set no new
+    minimum for ``_STALE_ROUNDS`` rounds (a limit cycle) — while the
+    count is still far above the convergence bar (``_stall_floor``) —
+    marks the run *stagnated*, and the next round re-detects COLD:
+    singleton init, full sweeps, independent keys.  This restores the
+    cold engine's convergence pressure when warm members lock into
+    diverse local optima or a shared oscillation.  A cold round resets
+    the state (its own fresh disagreement must not immediately
+    re-trigger).  Same f32/int rules as the driver's ``stalled()`` /
+    ``stale()`` / ``_stale_state``.
     """
     def empty_stats():
         z = jnp.zeros((block,), jnp.int32)
@@ -379,21 +418,25 @@ def consensus_rounds_block(slab: GraphSlab,
                           cold=jnp.zeros((block,), bool))
 
     def cond(carry):
-        _, i, conv, _, _, _, _ = carry
+        _, i, conv, _, _, _, _, _, _ = carry
         return (~conv) & (i < block) & (i < max_iters)
 
     def body(carry):
-        slab, i, _, buf, labels, aligned, prev = carry
+        slab, i, _, buf, labels, aligned, prev, mfrac, scount = carry
         k = prng.stream(key, prng.STREAM_ROUND, start_round + i)
         if warm:
-            stall = (prev[0] >= 0) & (prev[1] >= 0) & \
-                (prev[1].astype(jnp.float32) >=
-                 jnp.float32(0.9) * prev[0].astype(jnp.float32)) & \
-                (prev[1].astype(jnp.float32) >=
-                 _stall_floor(delta, prev[2]))
-            # alignment supersedes the refresh (run_consensus.round_mode):
+            have = prev[1] >= 0
+            u1f = prev[1].astype(jnp.float32)
+            stall = (prev[0] >= 0) & have & \
+                (u1f >= _stall_floor(delta, prev[2], 64.0)) & \
+                (u1f >= jnp.float32(0.9) * prev[0].astype(jnp.float32))
+            # limit cycle: no new FRACTION minimum for _STALE_ROUNDS
+            # rounds — fires even when aligned (run_consensus.round_mode)
+            stale = (scount >= _STALE_ROUNDS) & have & \
+                (u1f >= _stall_floor(delta, prev[2], 16.0))
+            # alignment supersedes the one-step rule only:
             # `aligned` is exactly "this round will run aligned"
-            cold = (start_round + i == 0) | (stall & ~aligned)
+            cold = (start_round + i == 0) | stale | (stall & ~aligned)
 
             def run_singleton(d):
                 def go(op):
@@ -427,9 +470,17 @@ def consensus_rounds_block(slab: GraphSlab,
             slab, labels, st = jax.lax.cond(
                 cold, run_cold, run_warm, (slab, k, labels, aligned))
             st = st._replace(cold=cold)
-            # cold rounds reset the stagnation pair: sentinel out u_prev2
-            prev = jnp.stack([jnp.where(cold, jnp.int32(-1), prev[1]),
-                              st.n_unconverged, st.n_alive])
+            # cold rounds reset the stagnation state (u_prev2 sentinel,
+            # fresh fraction minimum); otherwise track the running
+            # minimum — the exact incremental form of _stale_state
+            frac = st.n_unconverged.astype(jnp.float32) / \
+                jnp.maximum(st.n_alive, 1).astype(jnp.float32)
+            improved = cold | (frac < mfrac)
+            mfrac = jnp.where(improved, frac, mfrac)
+            scount = jnp.where(improved, jnp.int32(0), scount + 1)
+            prev = jnp.stack([
+                jnp.where(cold, jnp.int32(-1), prev[1]),
+                st.n_unconverged, st.n_alive])
         else:
             slab, labels, st = consensus_round(
                 slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
@@ -443,12 +494,15 @@ def consensus_rounds_block(slab: GraphSlab,
                 jnp.maximum(st.n_alive, 1).astype(jnp.float32)
         else:
             aligned = jnp.bool_(False)
-        return slab, i + 1, st.converged, buf, labels, aligned, prev
+        return (slab, i + 1, st.converged, buf, labels, aligned, prev,
+                mfrac, scount)
 
-    slab, done, _, buf, labels, _, _ = jax.lax.while_loop(
+    slab, done, _, buf, labels, _, _, _, _ = jax.lax.while_loop(
         cond, body,
         (slab, jnp.int32(0), jnp.bool_(False), empty_stats(), labels0,
-         jnp.asarray(align0, bool), jnp.asarray(unconv0, jnp.int32)))
+         jnp.asarray(align0, bool), jnp.asarray(unconv0, jnp.int32),
+         jnp.asarray(mfrac0, jnp.float32), jnp.asarray(scount0,
+                                                       jnp.int32)))
     return slab, done, buf, labels
 
 
@@ -1012,21 +1066,42 @@ def run_consensus(slab: GraphSlab,
         u1 = history[-1]["n_unconverged"]
         return bool(np.float32(u1) >= np.float32(0.9) * np.float32(u2)) \
             and bool(np.float32(u1) >= np.asarray(_stall_floor(
-                config.delta, history[-1]["n_alive"])))
+                config.delta, history[-1]["n_alive"], 64.0)))
+
+    def stale() -> bool:
+        """No strict new unconverged-fraction minimum for _STALE_ROUNDS
+        rounds — a warm limit cycle (see _stale_state); refresh regardless
+        of alignment."""
+        if not warm or not history:
+            return False
+        _, s = _stale_state(history)
+        if s < _STALE_ROUNDS:
+            return False
+        h = history[-1]
+        return bool(np.float32(h["n_unconverged"]) >=
+                    np.asarray(_stall_floor(config.delta, h["n_alive"],
+                                            16.0)))
 
     def round_mode(r0: int) -> str:
         """"cold" (round-0 / cold-run full-sweep base detector),
         "refresh" (warm-stagnation full-sweep low-variance refresh), or
         "warm" (capped-sweep warm variant).
 
-        Alignment SUPERSEDES the stagnation refresh: an aligned round's
-        residual disagreement is structural, and a refresh re-randomizes
-        every member with independent keys — measured on lfr10k (twice):
-        aligned rounds shrank the unconverged fraction monotonically
-        0.97 -> 0.24, then a refresh bounced it to 0.29+ and the run
-        re-diverged.  The refresh exists for UNALIGNED warm lock-in."""
+        Alignment supersedes the ONE-STEP stagnation rule: an aligned
+        round's residual disagreement is structural, and a refresh
+        re-randomizes every member — measured on lfr10k (twice): aligned
+        rounds shrank the unconverged fraction monotonically 0.97 -> 0.24,
+        then a refresh bounced it to 0.29+ and the run re-diverged.  But
+        the STALE-MINIMUM rule fires even when aligned: a limit cycle
+        (karate, measured) never sets a new minimum, and only a cold
+        refresh breaks it."""
         if not warm or r0 == cold_start_round:
             return "cold"
+        if stale():
+            _logger.warning(
+                "warm limit cycle (no new unconverged minimum in %d "
+                "rounds): round %d re-detects cold", _STALE_ROUNDS, r0)
+            return "refresh"
         if align_now(r0):
             return "warm"
         if stalled():
@@ -1124,6 +1199,7 @@ def run_consensus(slab: GraphSlab,
         if fused_block > 1:
             labels0 = cur_labels if warm else jnp.zeros(
                 (config.n_p, slab.n_nodes), jnp.int32)
+            stale_m, stale_s = _stale_state(history)
             unconv0 = jnp.asarray(
                 [history[-2]["n_unconverged"]
                  if len(history) >= 2 and not history[-1].get("cold")
@@ -1134,7 +1210,8 @@ def run_consensus(slab: GraphSlab,
             t0 = time.perf_counter()
             slab, done, buf, new_labels = block_fn(
                 slab, key, labels0, jnp.int32(r), jnp.int32(end_round - r),
-                jnp.bool_(align_now(r)), unconv0)
+                jnp.bool_(align_now(r)), unconv0,
+                jnp.float32(stale_m), jnp.int32(stale_s))
             done = int(done)
             buf = jax.device_get(buf)
             dt = time.perf_counter() - t0

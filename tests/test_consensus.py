@@ -405,6 +405,34 @@ def test_warm_stagnation_triggers_cold_refresh(tmp_path, caplog):
         np.testing.assert_array_equal(pa, pb)
 
 
+def test_limit_cycle_stale_refresh_karate(tmp_path, karate_slab):
+    """Measured: louvain consensus on karate with run key 123 enters a
+    warm limit cycle (unconverged 26 -> 34 -> 28 -> 31 -> ... for 64
+    rounds) that neither the one-step stall rule (the count never clears
+    its floor) nor alignment breaks — only the stale-fraction refresh
+    does.  The rule must fire (converge with cold refreshes present) and
+    the fused block must implement it bit-identically to the per-round
+    path."""
+    from fastconsensus_tpu.models.registry import get_detector
+
+    det = get_detector("louvain")
+    cfg = ConsensusConfig(algorithm="louvain", n_p=20, tau=0.2, delta=0.02,
+                          seed=0, max_rounds=64)
+    key = jax.random.key(123)
+    fused = run_consensus(karate_slab, det, cfg, key=key)
+    assert fused.converged and fused.rounds < 30, fused.rounds
+    assert sum(1 for h in fused.history if h.get("cold")) >= 2
+
+    single = run_consensus(karate_slab, det, cfg, key=key,
+                           checkpoint_path=str(tmp_path / "ck.npz"))
+    assert single.rounds == fused.rounds
+    strip = lambda h: {k: v for k, v in h.items() if k != "capacity"}
+    for a, b in zip(fused.history, single.history):
+        assert strip(a) == strip(b)
+    for pa, pb in zip(fused.partitions, single.partitions):
+        np.testing.assert_array_equal(pa, pb)
+
+
 def test_endgame_alignment_converges_no_slower(tmp_path):
     """ConsensusConfig.align_frac: once nearly converged, members share one
     detection key so content-keyed tie-breaks (louvain._community_reps)
