@@ -158,29 +158,65 @@ def sample_wedges_scatter(key: jax.Array, slab: GraphSlab, n_samples: int
     n = slab.n_nodes
     srcd, dstd, _, ad = slab.directed()
     valid_e = ad & (srcd != dstd)
+    u, v, ok = partner_draw_batches(
+        key, srcd, dstd, valid_e, n, slab.capacity, n_samples,
+        lambda score, segs, lab, m, num: seg.scatter_argmax_label(
+            segs, score, lab, m, num))
+    return jnp.where(ok, u, 0), jnp.where(ok, v, 0), ok
+
+
+def partner_draw_batches(key, srcd, dstd, valid_e, n: int, capacity: int,
+                         n_samples: int, argmax
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The wedge sampler's draw engine, shared verbatim by the unsharded
+    and shard_map tails (their winners must be bit-identical — the mesh
+    parity tests depend on it; only the argmax callee differs).
+
+    Batches G draws into ONE flat scatter-argmax over (draw, node)
+    segments — per-draw passes cost 1.6x a whole emailEu consensus on CPU
+    (measured round 3) — and runs ``lax.scan`` over fixed-size batch
+    groups so program size stays O(1) in the draw count (an unrolled loop
+    blew up tunnel compiles on dense graphs).  The group size is bounded
+    by BOTH the [G, 2*capacity] priority temporaries and the [G*(n+1)]
+    argmax buffers (the latter scale with the GLOBAL node count even on a
+    capacity-sharded mesh).
+
+    ``argmax(score, segs, label, valid, num) -> (best, score, has)``.
+    """
+    from fastconsensus_tpu.ops import segment as seg
+
     draws = -(-n_samples // max(n, 1))
+    if draws == 0:
+        z = jnp.zeros((0,), jnp.int32)
+        return z, z, jnp.zeros((0,), bool)
+    group = min(draws, max(1, 32_000_000 // max(2 * capacity, n + 1)))
+    n_groups = -(-draws // group)
+    ks = jax.vmap(
+        lambda d: jax.random.split(jax.random.fold_in(key, d)))(
+        jnp.arange(n_groups * group, dtype=jnp.int32))  # padded [D', 2]
 
-    def partner(k: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        pri = seg.pair_jitter(k, srcd, dstd, 1.0)
-        best, _, has = seg.scatter_argmax_label(srcd, pri, dstd, valid_e, n)
-        return best, has
+    def partners(keys):
+        g = keys.shape[0]
+        pri = jax.vmap(lambda k: seg.pair_jitter(k, srcd, dstd, 1.0))(keys)
+        seg_ids = (jnp.arange(g, dtype=jnp.int32)[:, None] * (n + 1) +
+                   jnp.where(valid_e, srcd, n)[None, :]).reshape(-1)
+        lab = jnp.broadcast_to(dstd, (g,) + dstd.shape).reshape(-1)
+        ok = jnp.broadcast_to(valid_e, (g,) + valid_e.shape).reshape(-1)
+        best, _, has = argmax(pri.reshape(-1), seg_ids, lab, ok,
+                              g * (n + 1))
+        return (best.reshape(g, n + 1)[:, :n],
+                has.reshape(g, n + 1)[:, :n])
 
-    def draw(_, d):
-        # lax.scan keeps program size O(1) in the draw count (an unrolled
-        # loop compiles ceil(L/N) scatter-argmax pairs into the round
-        # executable — on dense graphs that blew up tunnel compiles)
-        k1, k2 = jax.random.split(jax.random.fold_in(key, d))
-        p1, h1 = partner(k1)
-        p2, h2 = partner(k2)
+    def body(_, kchunk):
+        p1, h1 = partners(kchunk[:, 0])
+        p2, h2 = partners(kchunk[:, 1])
         ok = h1 & h2 & (p1 != p2)
         return None, (jnp.minimum(p1, p2), jnp.maximum(p1, p2), ok)
 
-    _, (us, vs, oks) = jax.lax.scan(draw, None,
-                                    jnp.arange(draws, dtype=jnp.int32))
-    u = us.reshape(-1)[:n_samples]
-    v = vs.reshape(-1)[:n_samples]
-    ok = oks.reshape(-1)[:n_samples]
-    return jnp.where(ok, u, 0), jnp.where(ok, v, 0), ok
+    _, (us, vs, oks) = jax.lax.scan(
+        body, None, ks.reshape(n_groups, group, 2))
+    return (us.reshape(-1)[:n_samples], vs.reshape(-1)[:n_samples],
+            oks.reshape(-1)[:n_samples])
 
 
 def insert_edges_hash(slab: GraphSlab,

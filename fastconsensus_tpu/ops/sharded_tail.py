@@ -118,32 +118,19 @@ def _num_alive(slab: GraphSlab) -> jax.Array:
 def _sample_wedges(key: jax.Array, slab: GraphSlab, n_samples: int):
     """consensus_ops.sample_wedges_scatter with the partner argmax taken
     across shards (same content-keyed priorities => same winners)."""
+    from fastconsensus_tpu.ops.consensus_ops import partner_draw_batches
+
     n = slab.n_nodes
     srcd = jnp.concatenate([slab.src, slab.dst])  # local concat: no comm
     dstd = jnp.concatenate([slab.dst, slab.src])
     ad = jnp.concatenate([slab.alive, slab.alive])
     valid_e = ad & (srcd != dstd)
-    draws = -(-n_samples // max(n, 1))
-
-    def partner(k):
-        pri = seg.pair_jitter(k, srcd, dstd, 1.0)
-        best, _, has = _node_argmax(pri, srcd, dstd, valid_e, n)
-        return best, has
-
-    def draw(_, d):
-        # lax.scan, not an unrolled loop: program size stays O(1) in the
-        # draw count (mirrors consensus_ops.sample_wedges_scatter)
-        k1, k2 = jax.random.split(jax.random.fold_in(key, d))
-        p1, h1 = partner(k1)
-        p2, h2 = partner(k2)
-        ok = h1 & h2 & (p1 != p2)
-        return None, (jnp.minimum(p1, p2), jnp.maximum(p1, p2), ok)
-
-    _, (us, vs, oks) = jax.lax.scan(draw, None,
-                                    jnp.arange(draws, dtype=jnp.int32))
-    u = us.reshape(-1)[:n_samples]
-    v = vs.reshape(-1)[:n_samples]
-    ok = oks.reshape(-1)[:n_samples]
+    # same draw engine as the unsharded sampler (bit-identical winners);
+    # only the argmax is the cross-shard pmax variant.  capacity here is
+    # the LOCAL chunk — the [G*(n+1)] argmax bound inside the helper keeps
+    # per-device temporaries shard-count-independent.
+    u, v, ok = partner_draw_batches(key, srcd, dstd, valid_e, n,
+                                    slab.capacity, n_samples, _node_argmax)
     return jnp.where(ok, u, 0), jnp.where(ok, v, 0), ok
 
 
