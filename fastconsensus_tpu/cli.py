@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "rounds share one PRNG key across ensemble members "
                         "(endgame tie-break alignment; 0 disables, 1 aligns "
                         "every warm round; default: engine default)")
+    p.add_argument("--closure-sampler", type=str, default="auto",
+                   choices=("auto", "csr", "scatter"),
+                   help="triadic-closure wedge sampler: csr (single-chip "
+                        "fast path), scatter (sort-free engine, required "
+                        "under an edge-sharded mesh), or auto (default: "
+                        "csr unsharded, scatter under a mesh)")
     p.add_argument("--cold-detect", action="store_true",
                    help="disable warm-started detection (every round "
                         "re-derives partitions from singletons, like the "
@@ -108,6 +114,11 @@ def check_arguments(args) -> Optional[str]:
         return f"np {args.n_p} out of range; need at least 1 partition"
     if args.max_rounds < 1:
         return "max-rounds must be >= 1"
+    if args.align_frac is not None and not 0.0 <= args.align_frac <= 1.0:
+        # a negative value silently disables alignment and > 1 behaves as
+        # 1 — surface the range like every other config error (ADVICE r3)
+        return (f"align-frac {args.align_frac} out of range; allowed "
+                f"values are 0..1")
     return None
 
 
@@ -160,7 +171,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                           delta=args.delta, max_rounds=args.max_rounds,
                           seed=args.seed, gamma=args.gamma,
                           auto_grow=not args.no_grow,
-                          warm_start=not args.cold_detect, **extra_cfg)
+                          warm_start=not args.cold_detect,
+                          closure_sampler=args.closure_sampler, **extra_cfg)
     from fastconsensus_tpu.utils.trace import RoundTracer, profiler_trace
 
     tracer = RoundTracer(jsonl_path=args.trace_jsonl)

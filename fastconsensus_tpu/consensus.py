@@ -99,6 +99,18 @@ class ConsensusConfig:
     # which lets members accumulate uncorrelated densification noise for
     # the first rounds, ended at 0.482.
     align_frac: float = 1.0
+    # Triadic-closure wedge sampler.  "csr": per-round CSR build (one
+    # argsort of the directed edges) + uniform anchor draws — the fastest
+    # single-chip lowering (the round-3 sort-free engine cost a measured
+    # 1.6x on emailEu/CPU, BASELINE.md r3).  "scatter": the sort-free
+    # batched partner-draw engine (ops/consensus_ops.py
+    # sample_wedges_scatter) — required under an edge-sharded mesh, where
+    # the CSR argsort would re-gather the whole slab every round.  "auto"
+    # (default): csr when unsharded, scatter under a mesh.  The two
+    # samplers draw different (equally valid) wedges, so sharded and
+    # unsharded runs are bitwise-comparable only when this is pinned to
+    # "scatter" (tests/test_parallel.py parity tests do exactly that).
+    closure_sampler: str = "auto"
 
 
 class RoundStats(NamedTuple):
@@ -125,10 +137,16 @@ def consensus_tail(slab: GraphSlab,
                    n_p: int,
                    tau: float,
                    delta: float,
-                   n_closure: int) -> Tuple[GraphSlab, RoundStats]:
+                   n_closure: int,
+                   sampler: str = "scatter") -> Tuple[GraphSlab, RoundStats]:
     """Everything after detection: co-membership -> threshold -> convergence
     -> closure -> repair.  Jittable; shared by the one-call
-    :func:`consensus_round` and the split-phase driver loop."""
+    :func:`consensus_round` and the split-phase driver loop.
+
+    ``sampler`` selects the wedge-sampling lowering (static; see
+    ConsensusConfig.closure_sampler): "csr" is the single-chip fast path,
+    "scatter" the edge-local engine the shard_map tail shares bit-exactly.
+    """
     counts = cops.comembership_counts(labels, slab.src, slab.dst)
     prev = slab  # round-start weights; used by singleton repair (fc:194)
     slab = cops.update_weights(slab, counts, n_p)
@@ -136,12 +154,17 @@ def consensus_tail(slab: GraphSlab,
     st_mid = cops.convergence_stats(slab, n_p, delta)
 
     def do_closure(slab):
-        # sort-free ops throughout: the CSR/lexsort variants re-gather the
-        # whole slab on an edge-sharded mesh (sample_wedges_scatter /
-        # insert_edges_hash docstrings)
         n0 = slab.num_alive()
-        cu, cv, cvalid = cops.sample_wedges_scatter(k_closure, slab,
-                                                    n_closure)
+        if sampler == "csr":
+            csr = cops.build_csr(slab)
+            cu, cv, cvalid = cops.sample_wedges(k_closure, csr,
+                                                slab.n_nodes, n_closure)
+        else:
+            # sort-free engine: required under an edge-sharded mesh, where
+            # the CSR argsort re-gathers the whole slab
+            # (sample_wedges_scatter docstring)
+            cu, cv, cvalid = cops.sample_wedges_scatter(k_closure, slab,
+                                                        n_closure)
         cw = cops.comembership_counts(labels, cu, cv)
         slab, dropped = cops.insert_edges_hash(slab, cu, cv, cw, cvalid)
         n1 = slab.num_alive()
@@ -265,7 +288,8 @@ def consensus_round(slab: GraphSlab,
                     n_closure: int,
                     ensemble_sharding=None,
                     init_labels: Optional[jax.Array] = None,
-                    align: bool = False
+                    align: bool = False,
+                    sampler: str = "scatter"
                     ) -> Tuple[GraphSlab, jax.Array, RoundStats]:
     """One full consensus round.  Jittable; all shapes static.
 
@@ -320,13 +344,14 @@ def consensus_round(slab: GraphSlab,
             ensemble_sharding.mesh)
     else:
         slab, stats = consensus_tail(slab, labels, k_closure, n_p, tau,
-                                     delta, n_closure)
+                                     delta, n_closure, sampler=sampler)
     return slab, labels, stats
 
 
 @functools.lru_cache(maxsize=128)
 def _jitted_round(detect: Detector, n_p: int, tau: float, delta: float,
-                  n_closure: int, ensemble_sharding):
+                  n_closure: int, ensemble_sharding,
+                  sampler: str = "scatter"):
     """Cache jitted round steps across run_consensus calls.
 
     ``jax.jit`` keys its executable cache on the *function object*; wrapping a
@@ -337,7 +362,8 @@ def _jitted_round(detect: Detector, n_p: int, tau: float, delta: float,
     """
     return jax.jit(functools.partial(
         consensus_round, detect=detect, n_p=n_p, tau=tau, delta=delta,
-        n_closure=n_closure, ensemble_sharding=ensemble_sharding))
+        n_closure=n_closure, ensemble_sharding=ensemble_sharding,
+        sampler=sampler))
 
 
 @functools.lru_cache(maxsize=64)
@@ -363,7 +389,8 @@ def consensus_rounds_block(slab: GraphSlab,
                            n_closure: int,
                            block: int,
                            warm: bool,
-                           align_frac: float = 0.0
+                           align_frac: float = 0.0,
+                           sampler: str = "scatter"
                            ) -> Tuple[GraphSlab, jax.Array, RoundStats,
                                       jax.Array]:
     """Up to ``min(block, max_iters)`` consensus rounds in ONE device call.
@@ -451,7 +478,7 @@ def consensus_rounds_block(slab: GraphSlab,
                     return consensus_round(
                         s, kk, detect=d, n_p=n_p, tau=tau, delta=delta,
                         n_closure=n_closure, init_labels=sing,
-                        align=False)
+                        align=False, sampler=sampler)
                 return go
 
             def run_cold(op):
@@ -469,7 +496,7 @@ def consensus_rounds_block(slab: GraphSlab,
                 return consensus_round(
                     s, kk, detect=detect_warm, n_p=n_p, tau=tau,
                     delta=delta, n_closure=n_closure, init_labels=lab,
-                    align=al)
+                    align=al, sampler=sampler)
 
             slab, labels, st = jax.lax.cond(
                 cold, run_cold, run_warm, (slab, k, labels, aligned))
@@ -489,7 +516,8 @@ def consensus_rounds_block(slab: GraphSlab,
         else:
             slab, labels, st = consensus_round(
                 slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
-                n_closure=n_closure, init_labels=None, align=False)
+                n_closure=n_closure, init_labels=None, align=False,
+                sampler=sampler)
             st = st._replace(cold=jnp.bool_(True))
             prev = jnp.stack([prev[2], prev[3],
                               st.n_unconverged, st.n_alive])
@@ -516,17 +544,18 @@ def consensus_rounds_block(slab: GraphSlab,
 def _jitted_rounds_block(detect: Detector, detect_warm: Detector,
                          detect_refresh: Detector, n_p: int,
                          tau: float, delta: float, n_closure: int,
-                         block: int, warm: bool, align_frac: float = 0.0):
+                         block: int, warm: bool, align_frac: float = 0.0,
+                         sampler: str = "scatter"):
     return jax.jit(functools.partial(
         consensus_rounds_block, detect=detect, detect_warm=detect_warm,
         detect_refresh=detect_refresh, n_p=n_p, tau=tau, delta=delta,
         n_closure=n_closure, block=block, warm=warm,
-        align_frac=align_frac))
+        align_frac=align_frac, sampler=sampler))
 
 
 @functools.lru_cache(maxsize=128)
 def _jitted_tail(n_p: int, tau: float, delta: float, n_closure: int,
-                 mesh=None):
+                 mesh=None, sampler: str = "scatter"):
     if mesh is not None:
         from fastconsensus_tpu.ops import sharded_tail as stail
 
@@ -534,7 +563,8 @@ def _jitted_tail(n_p: int, tau: float, delta: float, n_closure: int,
             stail.sharded_consensus_tail, n_p=n_p, tau=tau, delta=delta,
             n_closure=n_closure, mesh=mesh))
     return jax.jit(functools.partial(
-        consensus_tail, n_p=n_p, tau=tau, delta=delta, n_closure=n_closure))
+        consensus_tail, n_p=n_p, tau=tau, delta=delta, n_closure=n_closure,
+        sampler=sampler))
 
 
 def _members_per_call(slab: GraphSlab, n_p: int,
@@ -773,6 +803,27 @@ def run_consensus(slab: GraphSlab,
     if key is None:
         key = jax.random.key(config.seed)
     n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
+    if config.closure_sampler not in ("auto", "csr", "scatter"):
+        raise ValueError(
+            f"closure_sampler={config.closure_sampler!r}: expected "
+            f"'auto', 'csr' or 'scatter'")
+    if not 0.0 <= config.align_frac <= 1.0:
+        # out-of-range values would silently disable (or saturate)
+        # alignment (ADVICE r3)
+        raise ValueError(
+            f"align_frac={config.align_frac} out of range; allowed "
+            f"values are 0..1")
+    # Resolved wedge-sampling lowering (ConsensusConfig.closure_sampler):
+    # an edge-sharded mesh requires the sort-free engine; single-chip runs
+    # default to the CSR fast path.
+    if config.closure_sampler == "csr" and mesh is not None:
+        raise ValueError(
+            "closure_sampler='csr' is incompatible with a mesh: the CSR "
+            "argsort re-gathers the edge-sharded slab every round; use "
+            "'auto' or 'scatter'")
+    sampler = "scatter" if mesh is not None else (
+        "csr" if config.closure_sampler == "auto" else
+        config.closure_sampler)
     warm = config.warm_start and getattr(detect, "supports_init", False)
     # Endgame alignment only for detectors whose tie-breaks are
     # content-keyed (louvain._community_reps): without that, sharing keys
@@ -828,6 +879,17 @@ def run_consensus(slab: GraphSlab,
                 "migrating v1 checkpoint: restoring hybrid sizing "
                 "d_hyb=%d hub_cap=%d from the input pack", in_hyb, in_hub)
             slab = dataclasses.replace(slab, d_hyb=in_hyb, hub_cap=in_hub)
+        if extra.get("closure_sampler") is None:
+            # pre-r4 checkpoints predate the sampler knob; every such run
+            # used the scatter engine.  Continuing under "auto" must keep
+            # drawing the wedges the run was started with (an explicit
+            # --closure-sampler csr still fails the mismatch check below).
+            extra["closure_sampler"] = "scatter"
+            if config.closure_sampler == "auto":
+                _logger.info(
+                    "checkpoint predates closure_sampler; continuing with "
+                    "the scatter engine it was written with")
+                sampler = "scatter"
         if warm and extra.get("_labels") is not None:
             cur_labels = jnp.asarray(extra["_labels"])
         measured_member_s = extra.get("member_seconds") or None
@@ -837,11 +899,12 @@ def run_consensus(slab: GraphSlab,
         # (weights are co-membership counts out of the *saved* n_p).
         saved = {k: extra.get(k) for k in
                  ("algorithm", "n_p", "tau", "delta", "gamma",
-                  "warm_start", "align_frac")}
+                  "warm_start", "align_frac", "closure_sampler")}
         want = {"algorithm": config.algorithm, "n_p": config.n_p,
                 "tau": config.tau, "delta": config.delta,
                 "gamma": config.gamma, "warm_start": config.warm_start,
-                "align_frac": config.align_frac}
+                "align_frac": config.align_frac,
+                "closure_sampler": sampler}
         mismatch = {k: (saved[k], want[k]) for k in want
                     if saved[k] is not None and saved[k] != want[k]}
         if slab.n_nodes != in_nodes:
@@ -960,7 +1023,7 @@ def run_consensus(slab: GraphSlab,
                 (config.algorithm, config.n_p, config.tau, config.delta,
                  config.seed, config.max_rounds, slab.n_nodes,
                  slab.cap_hint or slab.capacity, config.gamma, warm,
-                 config.align_frac,
+                 config.align_frac, sampler,
                  tuple(mesh.shape.items()) if mesh is not None else None)
             ).encode()).hexdigest()[:10]
         forced = None
@@ -995,7 +1058,8 @@ def run_consensus(slab: GraphSlab,
             block_fn = _jitted_rounds_block(
                 detect, detect_warm, detect_refresh, config.n_p,
                 config.tau, config.delta, n_closure, fused_block, warm,
-                config.align_frac if (warm and align_ok) else 0.0)
+                config.align_frac if (warm and align_ok) else 0.0,
+                sampler)
 
     # Executable identities that already ran at least once since the last
     # setup: their next call is compile-free, so its wall time is an honest
@@ -1295,7 +1359,7 @@ def run_consensus(slab: GraphSlab,
                                 call_s=measured_member_s * members)
                 slab, stats = _jitted_tail(
                     config.n_p, config.tau, config.delta, n_closure,
-                    mesh)(slab, labels, k_closure)
+                    mesh, sampler)(slab, labels, k_closure)
                 stats = jax.device_get(stats)
                 while config.auto_grow and int(stats.n_dropped) > 0:
                     # capacity only matters after detection: replay just
@@ -1306,7 +1370,7 @@ def run_consensus(slab: GraphSlab,
                     grow_and_replay(pre_slab, int(stats.n_dropped))
                     slab, stats = _jitted_tail(
                         config.n_p, config.tau, config.delta, n_closure,
-                        mesh)(slab, labels, k_closure)
+                        mesh, sampler)(slab, labels, k_closure)
                     stats = jax.device_get(stats)
                 if warm:
                     cur_labels = labels
@@ -1317,7 +1381,7 @@ def run_consensus(slab: GraphSlab,
                                 "warm": detect_warm}[mode]
                 round_fn = _jitted_round(  # lru-cached: cheap per round
                     round_detect, config.n_p, config.tau,
-                    config.delta, n_closure, ensemble_sharding)
+                    config.delta, n_closure, ensemble_sharding, sampler)
                 t0 = time.perf_counter()
                 if warm:
                     # align passed traced: flipping it mid-run reuses the
@@ -1369,6 +1433,7 @@ def run_consensus(slab: GraphSlab,
                            "gamma": config.gamma,
                            "warm_start": config.warm_start,
                            "align_frac": config.align_frac,
+                           "closure_sampler": sampler,
                            "member_seconds": measured_member_s,
                            "converged": converged},
                     labels=(np.asarray(cur_labels) if warm else None))

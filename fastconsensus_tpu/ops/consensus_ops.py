@@ -147,7 +147,9 @@ def sample_wedges_scatter(key: jax.Array, slab: GraphSlab, n_samples: int
     exactly uniform over ordered distinct neighbor pairs — the reference's
     distribution.  Documented deviation: anchors are swept once per draw
     (every node appears ceil(L/N) times) instead of L independent uniform
-    node draws; the first ``n_samples`` of the draw grid are kept.
+    node draws; a key-rotated ``n_samples``-wide window of the draw grid
+    is kept (rotation prevents the remainder draws from always favoring
+    the lowest node ids — see partner_draw_batches).
 
     Priorities are content-keyed (hash of (u, v, salt), as
     segment.pair_jitter) so auto-growth replay reproduces the identical
@@ -215,8 +217,22 @@ def partner_draw_batches(key, srcd, dstd, valid_e, n: int, capacity: int,
 
     _, (us, vs, oks) = jax.lax.scan(
         body, None, ks.reshape(n_groups, group, 2))
-    return (us.reshape(-1)[:n_samples], vs.reshape(-1)[:n_samples],
-            oks.reshape(-1)[:n_samples])
+    # Keep a key-rotated window of the (draw, node) grid: keeping the first
+    # n_samples would hand every remainder draw (n_samples % n != 0) to the
+    # lowest node ids — a systematic per-round anchor bias (ADVICE r3).
+    # Offset and modulus derive from the UNPADDED grid (draws * n): the
+    # padded count n_groups*group depends on capacity through the group
+    # cap, and capacity differs between the unsharded tail (global) and
+    # the shard_map tail (local chunk) and changes under grow_and_replay —
+    # a capacity-dependent window would break both the mesh bit-parity
+    # contract and replay determinism.  fold_in(key, draws) may coincide
+    # with a PADDING draw's key (indices >= draws); those draws' outputs
+    # are never inside the unpadded window, so the collision is inert.
+    total = draws * n
+    off = jax.random.randint(
+        jax.random.fold_in(key, draws), (), 0, total, dtype=jnp.int32)
+    idx = (jnp.arange(n_samples, dtype=jnp.int32) + off) % jnp.int32(total)
+    return us.reshape(-1)[idx], vs.reshape(-1)[idx], oks.reshape(-1)[idx]
 
 
 def insert_edges_hash(slab: GraphSlab,

@@ -69,8 +69,13 @@ def test_edge_sharded_consensus_runs(karate_slab, karate_truth):
 
 
 def test_sharded_matches_unsharded_bitwise(karate_slab):
-    """Sharding must not change the math: same seed => same partitions."""
-    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.1, seed=7)
+    """Sharding must not change the math: same seed => same partitions.
+
+    closure_sampler pinned to "scatter": the unsharded default is the CSR
+    fast path, which draws different (equally valid) wedges than the
+    sort-free engine the sharded tail requires (ConsensusConfig)."""
+    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.1, seed=7,
+                          closure_sampler="scatter")
     det = get_detector("lpm")
     base = run_consensus(karate_slab, det, cfg)
     mesh = parallel.make_mesh()
@@ -84,7 +89,8 @@ def test_edge_sharded_matches_unsharded_bitwise(karate_slab):
     """2D mesh (p=4, e=2) bitwise parity on a small graph — the fast
     guard for the at-scale variant below (slow-marked), so the default
     suite still catches an edge-axis math regression."""
-    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.1, seed=7)
+    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.1, seed=7,
+                          closure_sampler="scatter")
     det = get_detector("lpm")
     base = run_consensus(karate_slab, det, cfg)
     mesh = parallel.make_mesh(ensemble=4, edge=2)
@@ -124,7 +130,7 @@ def test_edge_sharded_parity_at_scale():
     slab, _ = _big_skewed_graph()
     det = get_detector("lpm")
     cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.02,
-                          max_rounds=1, seed=2)
+                          max_rounds=1, seed=2, closure_sampler="scatter")
     base = run_consensus(slab, det, cfg)
     mesh = parallel.make_mesh(ensemble=4, edge=2)
     sharded = run_consensus(slab, det, cfg, mesh=mesh)
