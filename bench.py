@@ -227,7 +227,6 @@ def main() -> int:
         ens = max(d for d in range(1, n_chips + 1) if cfg["n_p"] % d == 0)
         mesh = parallel.make_mesh(ensemble=ens, edge=1,
                                   devices=jax.devices()[:ens])
-    slab = pack_edges(edges, n_nodes)
     detector = get_detector(cfg["alg"])
     ccfg = ConsensusConfig(algorithm=cfg["alg"], n_p=cfg["n_p"],
                            tau=cfg["tau"], delta=cfg["delta"], seed=0,
@@ -245,9 +244,23 @@ def main() -> int:
         on_round = RoundTracer().on_round
 
     rtt_pre = dispatch_rtt_ms()
-    # Warmup: pays all jit compiles (round step + final detection).
-    warm = run_consensus(slab, detector, ccfg, key=jax.random.key(123),
-                         mesh=mesh, on_round=on_round)
+    # Warmup: pays all jit compiles (round step + final detection).  If the
+    # warmup run auto-grows the slab, re-pack at the grown capacity and
+    # warm up again: a growth changes the compiled shapes mid-run, so the
+    # post-growth phases of a NON-growing timed run (different seed) would
+    # otherwise hit shapes the warmup never compiled — measured on
+    # emailEu: a ~14 s remote compile landed inside the timed window and
+    # read as a 5x engine regression.
+    cap = None
+    while True:
+        slab = pack_edges(edges, n_nodes, capacity=cap)
+        warm = run_consensus(slab, detector, ccfg, key=jax.random.key(123),
+                             mesh=mesh, on_round=on_round)
+        # growth multiplies capacity by >= 1.5 (grow_and_replay); a mesh
+        # pads by < its edge-axis size — only re-warm on real growth
+        if warm.graph.capacity < slab.capacity * 5 // 4:
+            break
+        cap = warm.graph.capacity
     # Timed run, fresh seed, same (cached) executables.
     t0 = time.perf_counter()
     result = run_consensus(slab, detector, ccfg, key=jax.random.key(0),

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from fastconsensus_tpu import policy, sizing
 from fastconsensus_tpu.graph import GraphSlab, pack_edges
 from fastconsensus_tpu.models.base import Detector
 from fastconsensus_tpu.ops import consensus_ops as cops
@@ -214,56 +215,6 @@ def consensus_tail(slab: GraphSlab,
     return slab, stats
 
 
-# Rounds without a strict new unconverged-FRACTION minimum before the
-# stale refresh fires (see _stall_floor / _stale_state).
-_STALE_ROUNDS = 4
-
-
-def _stall_floor(delta: float, n_alive, absolute: float) -> jnp.float32:
-    """Minimum mid-weight edge count for a stagnation rule to apply.
-
-    A 10%-relative rule alone misfires at endgame granularity (12 -> 11
-    unconverged is an 8% "stall") and near the convergence bar, where a
-    cold restart would blow away nearly-converged state.  Stagnation
-    therefore requires the count to still sit at >= 4x the ``delta``
-    convergence bar AND >= ``absolute`` (delta=0 runs).  The one-step
-    rule keeps 64 (it guards unaligned endgames grinding through small
-    counts); the stale/limit-cycle rule uses 16 — tiny graphs' whole
-    mid-weight band is ~30 edges (karate) and a 64 floor silently
-    disabled every refresh there, measured: a warm limit cycle ground 64
-    rounds.  f32 arithmetic, shared bit-exactly by the host
-    (run_consensus) and the fused block.
-    """
-    bar = jnp.float32(4.0) * jnp.float32(delta) * \
-        jnp.asarray(n_alive, jnp.float32)
-    return jnp.maximum(jnp.float32(absolute), bar)
-
-
-def _stale_state(history) -> Tuple[float, int]:
-    """(minimum unconverged FRACTION since the last cold round, rounds
-    since that minimum last improved) — the incremental form both the host
-    loop and the fused block maintain.  Catches warm LIMIT CYCLES: an
-    ensemble can oscillate (measured on karate: 26 -> 34 -> 28 -> 31 ->
-    ... for 64 rounds) without ever tripping the one-step 10% rule, and
-    alignment does not break the cycle — only a cold refresh does, so the
-    stale rule fires even on aligned rounds.  The FRACTION (not the
-    count) is tracked so healthy densifying runs — whose absolute
-    mid-weight count grows with the graph while the fraction falls
-    monotonically (lfr10k 0.97 -> 0.24, lfr100k 0.94 -> 0.55, measured)
-    — never trigger the refresh that would re-randomize them.  np/jnp
-    float32 division on both sides keeps host and fused block bit-exact.
-    """
-    m, s = np.float32(2.0), 0
-    for h in history:
-        frac = np.float32(h["n_unconverged"]) / \
-            np.float32(max(h["n_alive"], 1))
-        if h.get("cold") or frac < m:
-            m, s = frac, 0
-        else:
-            s += 1
-    return float(m), s
-
-
 def _maybe_align_keys(keys: jax.Array, align) -> jax.Array:
     """Give every ensemble member member 0's key when ``align`` is true.
 
@@ -377,9 +328,7 @@ def consensus_rounds_block(slab: GraphSlab,
                            start_round: jax.Array,
                            max_iters: jax.Array,
                            align0: jax.Array,
-                           unconv0: jax.Array,
-                           mfrac0: jax.Array,
-                           scount0: jax.Array,
+                           pstate0: policy.PolicyState,
                            detect: Detector,
                            detect_warm: Detector,
                            detect_refresh: Detector,
@@ -421,21 +370,14 @@ def consensus_rounds_block(slab: GraphSlab,
     — the contract above.  ``align_frac=0`` keeps alignment off (the
     driver passes 0 for detectors without content-keyed tie-breaks).
 
-    ``unconv0`` (traced int32[4] = [u_prev2, alive_prev2, u_prev1,
-    alive_prev1], -1 = unknown), ``mfrac0`` (traced f32: minimum
-    unconverged fraction since the last cold round) and ``scount0``
-    (traced int32: rounds since that minimum improved) are the stagnation
-    state entering the block.  A warm round that fails to shrink the
-    unconverged FRACTION by >= 10% (unaligned) / >= 5% (aligned — aligned
-    rounds legitimately progress more slowly, but measured on SBM-100k a
-    0.3%-per-round aligned grind must still hand over to a cold
-    re-derivation, which collapses it at once) — or ANY warm round when
-    the fraction set no new minimum for ``_STALE_ROUNDS`` rounds (a limit
-    cycle) — while the count is far above the convergence bar
-    (``_stall_floor``) — marks the run *stagnated*, and the next round
-    re-detects COLD: singleton init, full sweeps, independent keys.  A
-    cold round resets the state.  Same f32/int rules as the driver's
-    ``stalled()`` / ``stale()`` / ``_stale_state``.
+    ``pstate0`` (a ``policy.PolicyState`` of traced int32 scalars) is the
+    stagnation state entering the block.  Each in-block round evaluates
+    the SAME division-free rules the host driver evaluates between device
+    calls — ``policy.stalled`` (one-step relative progress), ``policy.
+    stale`` (limit cycle) — with ``xp = jnp`` instead of numpy; a firing
+    rule makes the next round re-detect COLD (singleton init, full sweeps,
+    independent keys), and ``policy.observe`` folds each round's stats
+    into the carried state exactly as the host's ``record()`` does.
     """
     def empty_stats():
         z = jnp.zeros((block,), jnp.int32)
@@ -445,28 +387,16 @@ def consensus_rounds_block(slab: GraphSlab,
                           cold=jnp.zeros((block,), bool))
 
     def cond(carry):
-        _, i, conv, _, _, _, _, _, _ = carry
+        _, i, conv, _, _, _, _ = carry
         return (~conv) & (i < block) & (i < max_iters)
 
     def body(carry):
-        slab, i, _, buf, labels, aligned, prev, mfrac, scount = carry
+        slab, i, _, buf, labels, aligned, pst = carry
         k = prng.stream(key, prng.STREAM_ROUND, start_round + i)
         if warm:
-            have = prev[2] >= 0
-            u1f = prev[2].astype(jnp.float32)
-            f2 = prev[0].astype(jnp.float32) / \
-                jnp.maximum(prev[1], 1).astype(jnp.float32)
-            f1 = u1f / jnp.maximum(prev[3], 1).astype(jnp.float32)
             # `aligned` is exactly "this round will run aligned"
-            factor = jnp.where(aligned, jnp.float32(0.95),
-                               jnp.float32(0.9))
-            stall = (prev[0] >= 0) & have & \
-                (u1f >= _stall_floor(delta, prev[3], 64.0)) & \
-                (f1 >= factor * f2)
-            # limit cycle: no new FRACTION minimum for _STALE_ROUNDS
-            # rounds (run_consensus.round_mode)
-            stale = (scount >= _STALE_ROUNDS) & have & \
-                (u1f >= _stall_floor(delta, prev[3], 16.0))
+            stall = policy.stalled(jnp, delta, pst, aligned)
+            stale = policy.stale(jnp, delta, pst)
             cold = (start_round + i == 0) | stale | stall
 
             def run_singleton(d):
@@ -501,42 +431,30 @@ def consensus_rounds_block(slab: GraphSlab,
             slab, labels, st = jax.lax.cond(
                 cold, run_cold, run_warm, (slab, k, labels, aligned))
             st = st._replace(cold=cold)
-            # cold rounds reset the stagnation state (u_prev2 sentinel,
-            # fresh fraction minimum); otherwise track the running
-            # minimum — the exact incremental form of _stale_state
-            frac = st.n_unconverged.astype(jnp.float32) / \
-                jnp.maximum(st.n_alive, 1).astype(jnp.float32)
-            improved = cold | (frac < mfrac)
-            mfrac = jnp.where(improved, frac, mfrac)
-            scount = jnp.where(improved, jnp.int32(0), scount + 1)
-            prev = jnp.stack([
-                jnp.where(cold, jnp.int32(-1), prev[2]),
-                jnp.where(cold, jnp.int32(-1), prev[3]),
-                st.n_unconverged, st.n_alive])
         else:
             slab, labels, st = consensus_round(
                 slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
                 n_closure=n_closure, init_labels=None, align=False,
                 sampler=sampler)
             st = st._replace(cold=jnp.bool_(True))
-            prev = jnp.stack([prev[2], prev[3],
-                              st.n_unconverged, st.n_alive])
+        # fold the round into the carried stagnation state — the same
+        # policy.observe the host's record() applies, so fused and
+        # per-round execution see identical rule inputs
+        pst = policy.observe(jnp, pst, st.cold, st.n_unconverged,
+                             st.n_alive)
         buf = jax.tree.map(lambda b, s: b.at[i].set(s), buf, st)
         if warm and align_frac > 0:
-            aligned = st.n_unconverged.astype(jnp.float32) <= \
-                jnp.float32(align_frac) * \
-                jnp.maximum(st.n_alive, 1).astype(jnp.float32)
+            aligned = policy.align_now(jnp, align_frac, pst)
         else:
             aligned = jnp.bool_(False)
-        return (slab, i + 1, st.converged, buf, labels, aligned, prev,
-                mfrac, scount)
+        return (slab, i + 1, st.converged, buf, labels, aligned, pst)
 
-    slab, done, _, buf, labels, _, _, _, _ = jax.lax.while_loop(
+    pst0 = policy.PolicyState(*(jnp.asarray(v, jnp.int32)
+                                for v in pstate0))
+    slab, done, _, buf, labels, _, _ = jax.lax.while_loop(
         cond, body,
         (slab, jnp.int32(0), jnp.bool_(False), empty_stats(), labels0,
-         jnp.asarray(align0, bool), jnp.asarray(unconv0, jnp.int32),
-         jnp.asarray(mfrac0, jnp.float32), jnp.asarray(scount0,
-                                                       jnp.int32)))
+         jnp.asarray(align0, bool), pst0))
     return slab, done, buf, labels
 
 
@@ -565,104 +483,6 @@ def _jitted_tail(n_p: int, tau: float, delta: float, n_closure: int,
     return jax.jit(functools.partial(
         consensus_tail, n_p=n_p, tau=tau, delta=delta, n_closure=n_closure,
         sampler=sampler))
-
-
-def _members_per_call(slab: GraphSlab, n_p: int,
-                      detect: Optional[Detector] = None,
-                      measured_s: Optional[float] = None,
-                      alg: Optional[str] = None) -> int:
-    """How many ensemble members one detection device-call should carry.
-
-    A single XLA execution must stay well under the TPU tunnel's ~60 s
-    single-call ceiling (a longer execute kills the worker), and splitting
-    detection into several calls also keeps the driver responsive for
-    checkpoint/trace hooks.  Targets ~15 s per call (a 4x safety margin).
-
-    Per-member time: ``measured_s`` — the actual on-device rate from this
-    run's own detection calls (run_consensus feeds it back after every
-    round and persists it in checkpoints, so resumes re-derive identical
-    chunking) — or, before anything has been measured in this process, the
-    :func:`_est_member_seconds` prior (a rate previously measured on this
-    backend if one is persisted — utils/calibrate.py — else the hardcoded
-    ``_NS_PER_TEMP_BYTE`` table).  FCTPU_DETECT_CALL_MEMBERS overrides
-    everything (<= 0 disables splitting).
-    """
-    c = env_int("FCTPU_DETECT_CALL_MEMBERS")
-    if c is not None:
-        return n_p if c <= 0 else min(c, n_p)
-    per = measured_s if measured_s else _est_member_seconds(slab, detect, alg)
-    return max(1, min(n_p, int(15.0 / max(per, 1e-9))))
-
-
-# Never-measured prior: effective cost per byte of per-sweep temporaries,
-# by move path (TPU v5e via the dev tunnel): the matmul path streams
-# (MXU/HBM-bound), dense pays the row sort / pallas compare, hash and runs
-# are scatter/sort-bound; hybrid sits between dense and hash (narrow rows +
-# small scatters).  Calibrated against lfr1k (matmul), planted-100k
-# (dense) and lfr10k (hash/hybrid) detections.  Once a run has measured a
-# real rate on a backend it is persisted and preferred
-# (utils/calibrate.py), so this table is load-bearing only for the very
-# first run on fresh hardware.
-_NS_PER_TEMP_BYTE = {"matmul": 0.02, "dense": 0.2, "hybrid": 0.3,
-                     "hash": 0.8, "runs": 1.5}
-
-# Shortest device call whose wall time is persisted as a calibration rate
-# (run_consensus.record_rate): below this, host-device dispatch/readback
-# latency dominates and the derived ns/byte would be garbage.
-_MIN_PERSIST_CALL_S = 2.0
-
-
-def _member_temp_bytes(slab: GraphSlab) -> int:
-    """The denominator of the ns-per-byte rate unit — shared by the
-    estimator and the recorder (record_rate), and baked into persisted
-    calibration files: both sides MUST use this one definition or every
-    stored rate silently mis-scales."""
-    from fastconsensus_tpu.models import louvain
-
-    return 96 * louvain.sweep_temp_bytes(slab)
-
-
-def _est_member_seconds(slab: GraphSlab,
-                        detect: Optional[Detector] = None,
-                        alg: Optional[str] = None) -> float:
-    """Per-ensemble-member detection time estimate for call sizing.
-
-    Prefers a rate measured on this backend by an earlier run (persisted —
-    utils/calibrate.py; it embodies the detector's full per-member cost).
-    Falls back to the ``_NS_PER_TEMP_BYTE`` prior scaled by the detector's
-    ``cost_mult`` hint (multi-phase detectors like leiden).
-    """
-    from fastconsensus_tpu.models import louvain
-    from fastconsensus_tpu.utils import calibrate
-
-    path = louvain.select_move_path(slab)
-    temp_bytes = _member_temp_bytes(slab)
-    if alg is not None:
-        rate = calibrate.get_rate(jax.default_backend(), path, alg)
-        if rate is not None:
-            return temp_bytes * rate * 1e-9
-    mult = getattr(detect, "cost_mult", 1.0) if detect is not None else 1.0
-    return temp_bytes * _NS_PER_TEMP_BYTE[path] * 1e-9 * mult
-
-
-def _read_sizing(cache_dir: str) -> Optional[dict]:
-    """The detect-call sizing a previous process used with this chunk-cache
-    dir (see setup_executables: restart must reuse the killed run's
-    chunking or every persisted chunk of the round is orphaned)."""
-    import json
-
-    try:
-        with open(os.path.join(cache_dir, "sizing.json")) as fh:
-            return json.load(fh)
-    except (OSError, ValueError):
-        return None
-
-
-def _write_sizing(cache_dir: str, fp: str, members: int) -> None:
-    from fastconsensus_tpu.utils.calibrate import atomic_write_json
-
-    atomic_write_json(os.path.join(cache_dir, "sizing.json"),
-                      {"fp": fp, "members": members})
 
 
 def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
@@ -975,7 +795,7 @@ def run_consensus(slab: GraphSlab,
         backend calibration, or the static prior — in that order).
         ``force_members`` pins the member count (chunk-cache adoption)."""
         m = force_members if force_members is not None else mesh_rounded(
-            _members_per_call(
+            sizing.members_per_call(
                 slab, config.n_p, detect, measured_s=measured_member_s,
                 alg=config.algorithm))
         sp = m < config.n_p
@@ -988,7 +808,8 @@ def run_consensus(slab: GraphSlab,
         if not sp and checkpoint_path is None and mesh is None:
             round_s = (measured_member_s * config.n_p
                        if measured_member_s else
-                       _est_member_seconds(slab, detect, config.algorithm)
+                       sizing.est_member_seconds(slab, detect,
+                                                 config.algorithm)
                        * config.n_p)
             fb = max(1, min(8, int(15.0 / max(round_s, 1e-9))))
         return m, sp, fb
@@ -1039,7 +860,7 @@ def run_consensus(slab: GraphSlab,
             # used is persisted next to the chunks and adopted on the
             # process's FIRST setup only — later setups exist to change
             # sizing (growth, measured re-sizes) and overwrite the file.
-            prev = _read_sizing(detect_cache_dir)
+            prev = sizing.read_sizing(detect_cache_dir)
             if prev is not None and prev.get("fp") == fp_base:
                 forced = int(prev["members"])
         members, split_phase, fused_block = derive_sizing(forced)
@@ -1051,7 +872,7 @@ def run_consensus(slab: GraphSlab,
             # must not load mis-sized chunks.
             cache_fp = hashlib.sha1(repr(
                 (fp_base, members)).encode()).hexdigest()[:10]
-            _write_sizing(detect_cache_dir, fp_base, members)
+            sizing.write_sizing(detect_cache_dir, fp_base, members)
         first_setup = False
         block_fn = None
         if fused_block > 1:
@@ -1083,7 +904,7 @@ def run_consensus(slab: GraphSlab,
         (measured_member_s) — there the latency is part of the real cost
         of the call being sized.
         """
-        if call_s < _MIN_PERSIST_CALL_S:
+        if call_s < sizing.MIN_PERSIST_CALL_S:
             return
         from fastconsensus_tpu.models import louvain
         from fastconsensus_tpu.utils import calibrate
@@ -1091,7 +912,7 @@ def run_consensus(slab: GraphSlab,
         calibrate.update_rate(
             jax.default_backend(), louvain.select_move_path(slab),
             config.algorithm,
-            member_s / _member_temp_bytes(slab) * 1e9,
+            member_s / sizing.member_temp_bytes(slab) * 1e9,
             "cold" if cold else "warm")
 
     def maybe_resize() -> None:
@@ -1116,75 +937,30 @@ def run_consensus(slab: GraphSlab,
                 measured_member_s, members, m, fused_block, fb)
             setup_executables()
 
-    def stalled(will_align: bool) -> bool:
-        """Warm stagnation: the last round failed to shrink the unconverged
-        FRACTION by >= 10% (>= 5% when this round will run aligned —
-        aligned rounds progress more slowly but legitimately; measured on
-        SBM-100k, a 0.3%-per-round aligned grind must still hand over).
-        Warm members can lock into diverse local optima — each is at ITS
-        fixpoint, so disagreement stops falling while triadic closure
-        densifies the graph (measured round 3: warm leiden on lfr10k grew
-        the consensus graph ~30k edges/round without converging).  The
-        cure is a COLD round: re-derive every member from the current
-        weights with independent keys, then resume warm from the
-        refreshed labels (on SBM-100k the cold engine collapses the
-        fraction 0.99 -> 0.31 in one round where the aligned grind moved
-        it 0.003).  A cold round resets the state.  f32 arithmetic,
-        matching the in-block rule bit-exactly."""
-        if not warm or len(history) < 2:
-            return False
-        if history[-1].get("cold"):
-            return False
-        h2, h1 = history[-2], history[-1]
-        f2 = np.float32(h2["n_unconverged"]) / \
-            np.float32(max(h2["n_alive"], 1))
-        f1 = np.float32(h1["n_unconverged"]) / \
-            np.float32(max(h1["n_alive"], 1))
-        factor = np.float32(0.95) if will_align else np.float32(0.9)
-        return bool(np.float32(h1["n_unconverged"]) >= np.asarray(
-            _stall_floor(config.delta, h1["n_alive"], 64.0))) \
-            and bool(f1 >= factor * f2)
-
-    def stale() -> bool:
-        """No strict new unconverged-fraction minimum for _STALE_ROUNDS
-        rounds — a warm limit cycle (see _stale_state); refresh regardless
-        of alignment."""
-        if not warm or not history:
-            return False
-        _, s = _stale_state(history)
-        if s < _STALE_ROUNDS:
-            return False
-        h = history[-1]
-        return bool(np.float32(h["n_unconverged"]) >=
-                    np.asarray(_stall_floor(config.delta, h["n_alive"],
-                                            16.0)))
-
     def round_mode(r0: int) -> str:
         """"cold" (round-0 / cold-run full-sweep base detector),
         "refresh" (warm-stagnation full-sweep low-variance refresh), or
         "warm" (capped-sweep warm variant).
 
-        Alignment earns a gentler one-step threshold (5% vs 10% relative
-        fraction progress — aligned lfr10k rounds progressed 15-37%/round
-        where unaligned ones plateaued) but does NOT suppress the rule:
-        measured on SBM-100k, an aligned warm grind at 0.3%/round must
-        hand over to the cold re-derivation that collapses it at once.
-        The STALE-MINIMUM rule also fires regardless of alignment: a limit
-        cycle (karate, measured) never sets a new minimum, and only a
-        cold refresh breaks it."""
+        The stall/stale/align rules live ONCE in ``policy`` (division-free
+        f32, evaluated here with numpy and inside the fused block with
+        jnp — fused and per-round execution must take identical
+        decisions).  Alignment earns a gentler one-step threshold but does
+        NOT suppress the stall rule, and the stale (limit-cycle) rule
+        fires regardless of alignment — the measurements behind both are
+        on the policy module."""
         if not warm or r0 == cold_start_round:
             return "cold"
-        if stale():
+        if bool(policy.stale(np, config.delta, pstate)):
             _logger.warning(
                 "warm limit cycle (no new unconverged-fraction minimum "
-                "in %d rounds): round %d re-detects cold", _STALE_ROUNDS,
-                r0)
+                "in %d rounds): round %d re-detects cold",
+                policy.STALE_ROUNDS, r0)
             return "refresh"
-        if stalled(align_now(r0)):
+        if bool(policy.stalled(np, config.delta, pstate, align_now(r0))):
             _logger.warning(
                 "warm stagnation (unconverged %d -> %d): round %d "
-                "re-detects cold", history[-2]["n_unconverged"],
-                history[-1]["n_unconverged"], r0)
+                "re-detects cold", int(pstate.u2), int(pstate.u1), r0)
             return "refresh"
         return "warm"
 
@@ -1198,12 +974,7 @@ def run_consensus(slab: GraphSlab,
             return False
         if r0 == cold_start_round:
             return False
-        h = history[-1]
-        # float32 on both sides: the in-block rule (consensus_rounds_block)
-        # evaluates this threshold in f32, and fused/per-round execution
-        # must agree bit-exactly at the boundary
-        return np.float32(h["n_unconverged"]) <= \
-            np.float32(config.align_frac) * np.float32(max(h["n_alive"], 1))
+        return bool(policy.align_now(np, config.align_frac, pstate))
 
     def grow_and_replay(pre_slab: GraphSlab, dropped: int) -> None:
         """Self-sizing slab: grow from the *pre-round* state and let the
@@ -1228,8 +999,10 @@ def run_consensus(slab: GraphSlab,
         setup_executables()
 
     def record(stats) -> bool:
-        """Append one round's (host-side) stats; returns converged."""
-        nonlocal rounds, converged
+        """Append one round's (host-side) stats; returns converged.  Also
+        folds the round into the running policy state — the same
+        policy.observe the fused block applies in its carry."""
+        nonlocal rounds, converged, pstate
         rounds += 1
         entry = {
             "round": rounds,
@@ -1244,12 +1017,20 @@ def run_consensus(slab: GraphSlab,
             "capacity": slab.capacity,
         }
         history.append(entry)
+        pstate = policy.observe(np, pstate, np.bool_(entry["cold"]),
+                                np.int32(entry["n_unconverged"]),
+                                np.int32(entry["n_alive"]))
         if on_round is not None:
             on_round(entry)
         converged = bool(stats.converged)
         return converged
 
     history: List[dict] = list(prior_history)
+    # Stagnation/alignment state (policy.PolicyState), reconstructed from
+    # the (possibly resumed) history and maintained incrementally by
+    # record(); the single source both round_mode and the fused block's
+    # carry seed read.
+    pstate = policy.state_from_history(history)
     converged = resumed_converged
     rounds = start_round
     end_round = start_round if resumed_converged else config.max_rounds
@@ -1275,19 +1056,11 @@ def run_consensus(slab: GraphSlab,
         if fused_block > 1:
             labels0 = cur_labels if warm else jnp.zeros(
                 (config.n_p, slab.n_nodes), jnp.int32)
-            stale_m, stale_s = _stale_state(history)
-            have2 = len(history) >= 2 and not history[-1].get("cold")
-            unconv0 = jnp.asarray(
-                [history[-2]["n_unconverged"] if have2 else -1,
-                 history[-2]["n_alive"] if have2 else -1,
-                 history[-1]["n_unconverged"] if history else -1,
-                 history[-1]["n_alive"] if history else -1],
-                jnp.int32)
             t0 = time.perf_counter()
             slab, done, buf, new_labels = block_fn(
                 slab, key, labels0, jnp.int32(r), jnp.int32(end_round - r),
-                jnp.bool_(align_now(r)), unconv0,
-                jnp.float32(stale_m), jnp.int32(stale_s))
+                jnp.bool_(align_now(r)),
+                policy.PolicyState(*(jnp.int32(v) for v in pstate)))
             done = int(done)
             buf = jax.device_get(buf)
             dt = time.perf_counter() - t0

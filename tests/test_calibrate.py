@@ -12,13 +12,13 @@ from fastconsensus_tpu.utils.synth import planted_partition
 
 @pytest.fixture
 def calib_dir(tmp_path, monkeypatch):
-    from fastconsensus_tpu import consensus as cmod
+    from fastconsensus_tpu import sizing as szmod
 
     monkeypatch.setenv("FCTPU_CALIBRATE", "1")
     monkeypatch.setenv("FCTPU_CALIBRATE_DIR", str(tmp_path))
     # CPU test runs are sub-second per call; drop the latency gate so they
     # still exercise the persistence path
-    monkeypatch.setattr(cmod, "_MIN_PERSIST_CALL_S", 0.0)
+    monkeypatch.setattr(szmod, "MIN_PERSIST_CALL_S", 0.0)
     calibrate._cache = calibrate._cache_path = None
     yield tmp_path
     calibrate._cache = calibrate._cache_path = None
@@ -82,7 +82,7 @@ def test_run_persists_measured_rate(calib_dir, tmp_path):
     first run; the next process's first-call sizing consults it."""
     import jax
 
-    from fastconsensus_tpu.consensus import _est_member_seconds
+    from fastconsensus_tpu.sizing import est_member_seconds
 
     edges, _ = planted_partition(120, 4, 0.35, 0.02, seed=8)
     slab = pack_edges(edges, 120)
@@ -97,6 +97,6 @@ def test_run_persists_measured_rate(calib_dir, tmp_path):
     rate = calibrate.get_rate(backend, "matmul", "lpm")
     assert rate is not None and rate > 0
     # the estimator prefers the measured rate over the static table
-    est = _est_member_seconds(slab, get_detector("lpm"), alg="lpm")
+    est = est_member_seconds(slab, get_detector("lpm"), alg="lpm")
     from fastconsensus_tpu.models.louvain import sweep_temp_bytes
     assert est == pytest.approx(96 * sweep_temp_bytes(slab) * rate * 1e-9)

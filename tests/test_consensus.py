@@ -214,8 +214,8 @@ def test_fused_rounds_match_single_rounds(monkeypatch):
     fused = run_consensus(slab, det, cfg)
 
     # force per-round execution by making the round estimate enormous
-    from fastconsensus_tpu import consensus as cmod
-    monkeypatch.setitem(cmod._NS_PER_TEMP_BYTE, "matmul", 1e6)
+    from fastconsensus_tpu import sizing as szmod
+    monkeypatch.setitem(szmod.NS_PER_TEMP_BYTE, "matmul", 1e6)
     single = run_consensus(slab, det, cfg)
 
     assert fused.rounds == single.rounds
@@ -232,7 +232,7 @@ def test_fused_rounds_match_single_rounds_aligned(monkeypatch):
     their own stats, so fusion stays result-invariant even when alignment
     engages mid-run (round-3 review: a timing-dependent fused/unfused
     choice must never change partitions)."""
-    from fastconsensus_tpu import consensus as cmod
+    from fastconsensus_tpu import sizing as szmod
     from fastconsensus_tpu.models.registry import get_detector
     from fastconsensus_tpu.utils.synth import planted_partition
 
@@ -247,7 +247,7 @@ def test_fused_rounds_match_single_rounds_aligned(monkeypatch):
     assert any(h["n_unconverged"] <= 0.5 * h["n_alive"]
                for h in fused.history[:-1]), "alignment never engaged"
 
-    monkeypatch.setitem(cmod._NS_PER_TEMP_BYTE, "matmul", 1e6)
+    monkeypatch.setitem(szmod.NS_PER_TEMP_BYTE, "matmul", 1e6)
     single = run_consensus(slab, det, cfg)
 
     assert fused.rounds == single.rounds
