@@ -29,33 +29,12 @@ import os
 import sys
 import time
 
-# Persistent XLA compilation cache: the TPU tunnel's remote-compile service
-# is slow and occasionally degraded (observed: 65 s for a trivial program),
-# so cache compiled executables on disk across bench runs.  Must be set
-# before jax imports.  Keyed by a host-CPU fingerprint: an XLA:CPU AOT
-# executable loaded on a host with different CPU features aborts the
-# process (see tests/conftest.py).
-
-
-def _host_tag() -> str:
-    # keep in sync with tests/conftest.py:_host_tag (see note there)
-    import hashlib
-
-    try:
-        with open("/proc/cpuinfo") as fh:
-            flags = next(line for line in fh if line.startswith("flags"))
-        return hashlib.sha1(flags.encode()).hexdigest()[:8]
-    except (OSError, StopIteration):
-        return "generic"
-
-
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.expanduser(f"~/.cache/fctpu_xla_{_host_tag()}"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
-
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
+
+from fastconsensus_tpu.utils.env import setup_compile_cache  # noqa: E402
+
+setup_compile_cache()
 BASELINE_CACHE = os.path.join(REPO, "BENCH_BASELINE.json")
 
 CONFIGS = {

@@ -79,6 +79,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "fast path), scatter (sort-free engine, required "
                         "under an edge-sharded mesh), or auto (default: "
                         "csr unsharded, scatter under a mesh)")
+    p.add_argument("--closure-tau", type=float, default=None,
+                   metavar="FRAC",
+                   help="minimum co-membership fraction for a triadic-"
+                        "closure insert (threshold-at-insert; densification "
+                        "control). Default: no bar, matching the reference; "
+                        "try the -t threshold value when a theta-randomized "
+                        "run densifies without converging")
     p.add_argument("--cold-detect", action="store_true",
                    help="disable warm-started detection (every round "
                         "re-derives partitions from singletons, like the "
@@ -114,6 +121,9 @@ def check_arguments(args) -> Optional[str]:
         return f"np {args.n_p} out of range; need at least 1 partition"
     if args.max_rounds < 1:
         return "max-rounds must be >= 1"
+    if args.closure_tau is not None and not 0.0 <= args.closure_tau <= 1.0:
+        return (f"closure-tau {args.closure_tau} out of range; allowed "
+                f"values are 0..1")
     if args.align_frac is not None and not 0.0 <= args.align_frac <= 1.0:
         # a negative value silently disables alignment and > 1 behaves as
         # 1 — surface the range like every other config error (ADVICE r3)
@@ -131,6 +141,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(err, file=sys.stderr)
         return 2
 
+    from fastconsensus_tpu.utils.env import setup_compile_cache
+
+    setup_compile_cache()
     # Imports deferred so `-h` and argument errors never pay jax startup.
     from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
     from fastconsensus_tpu.graph import pack_edges
@@ -172,7 +185,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                           seed=args.seed, gamma=args.gamma,
                           auto_grow=not args.no_grow,
                           warm_start=not args.cold_detect,
-                          closure_sampler=args.closure_sampler, **extra_cfg)
+                          closure_sampler=args.closure_sampler,
+                          closure_tau=args.closure_tau, **extra_cfg)
     from fastconsensus_tpu.utils.trace import RoundTracer, profiler_trace
 
     tracer = RoundTracer(jsonl_path=args.trace_jsonl)

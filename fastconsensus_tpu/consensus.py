@@ -112,6 +112,19 @@ class ConsensusConfig:
     # unsharded runs are bitwise-comparable only when this is pinned to
     # "scatter" (tests/test_parallel.py parity tests do exactly that).
     closure_sampler: str = "auto"
+    # Threshold-at-insert for triadic closure: a closure candidate is
+    # inserted only if its co-membership weight is >= closure_tau * n_p
+    # (None disables — the reference's semantics, fc:175-191, which
+    # inserts any-weight closure edges and lets the NEXT round's tau
+    # threshold kill the weak ones after they influenced one detection
+    # round).  Densification control (VERDICT r3 Missing #1): on
+    # theta-randomized leiden, closure inserts ~30k candidates/round of
+    # which ~20k earn partial agreement and stick, densifying the
+    # consensus graph faster than members can agree — delta-convergence
+    # became unreachable on lfr10k/mu0.5.  Setting closure_tau = tau
+    # drops the sub-threshold inserts one round early (cheaper, nearly
+    # equivalent: a warm ensemble's counts barely change between rounds).
+    closure_tau: Optional[float] = None
 
 
 class RoundStats(NamedTuple):
@@ -139,7 +152,9 @@ def consensus_tail(slab: GraphSlab,
                    tau: float,
                    delta: float,
                    n_closure: int,
-                   sampler: str = "scatter") -> Tuple[GraphSlab, RoundStats]:
+                   sampler: str = "scatter",
+                   closure_tau: Optional[float] = None
+                   ) -> Tuple[GraphSlab, RoundStats]:
     """Everything after detection: co-membership -> threshold -> convergence
     -> closure -> repair.  Jittable; shared by the one-call
     :func:`consensus_round` and the split-phase driver loop.
@@ -167,6 +182,10 @@ def consensus_tail(slab: GraphSlab,
             cu, cv, cvalid = cops.sample_wedges_scatter(k_closure, slab,
                                                         n_closure)
         cw = cops.comembership_counts(labels, cu, cv)
+        if closure_tau is not None:
+            # threshold-at-insert (ConsensusConfig.closure_tau)
+            cvalid = cvalid & (cw >= jnp.float32(closure_tau) *
+                               jnp.float32(n_p))
         slab, dropped = cops.insert_edges_hash(slab, cu, cv, cw, cvalid)
         n1 = slab.num_alive()
         su, sv, sw, svalid = cops.singleton_candidates(slab, prev)
@@ -240,7 +259,8 @@ def consensus_round(slab: GraphSlab,
                     ensemble_sharding=None,
                     init_labels: Optional[jax.Array] = None,
                     align: bool = False,
-                    sampler: str = "scatter"
+                    sampler: str = "scatter",
+                    closure_tau: Optional[float] = None
                     ) -> Tuple[GraphSlab, jax.Array, RoundStats]:
     """One full consensus round.  Jittable; all shapes static.
 
@@ -292,17 +312,19 @@ def consensus_round(slab: GraphSlab,
 
         slab, stats = stail.sharded_consensus_tail(
             slab, labels, k_closure, n_p, tau, delta, n_closure,
-            ensemble_sharding.mesh)
+            ensemble_sharding.mesh, closure_tau=closure_tau)
     else:
         slab, stats = consensus_tail(slab, labels, k_closure, n_p, tau,
-                                     delta, n_closure, sampler=sampler)
+                                     delta, n_closure, sampler=sampler,
+                                     closure_tau=closure_tau)
     return slab, labels, stats
 
 
 @functools.lru_cache(maxsize=128)
 def _jitted_round(detect: Detector, n_p: int, tau: float, delta: float,
                   n_closure: int, ensemble_sharding,
-                  sampler: str = "scatter"):
+                  sampler: str = "scatter",
+                  closure_tau: Optional[float] = None):
     """Cache jitted round steps across run_consensus calls.
 
     ``jax.jit`` keys its executable cache on the *function object*; wrapping a
@@ -314,7 +336,7 @@ def _jitted_round(detect: Detector, n_p: int, tau: float, delta: float,
     return jax.jit(functools.partial(
         consensus_round, detect=detect, n_p=n_p, tau=tau, delta=delta,
         n_closure=n_closure, ensemble_sharding=ensemble_sharding,
-        sampler=sampler))
+        sampler=sampler, closure_tau=closure_tau))
 
 
 @functools.lru_cache(maxsize=64)
@@ -329,6 +351,8 @@ def consensus_rounds_block(slab: GraphSlab,
                            max_iters: jax.Array,
                            align0: jax.Array,
                            pstate0: policy.PolicyState,
+                           watch0: jax.Array,
+                           noop0: jax.Array,
                            detect: Detector,
                            detect_warm: Detector,
                            detect_refresh: Detector,
@@ -339,7 +363,8 @@ def consensus_rounds_block(slab: GraphSlab,
                            block: int,
                            warm: bool,
                            align_frac: float = 0.0,
-                           sampler: str = "scatter"
+                           sampler: str = "scatter",
+                           closure_tau: Optional[float] = None
                            ) -> Tuple[GraphSlab, jax.Array, RoundStats,
                                       jax.Array]:
     """Up to ``min(block, max_iters)`` consensus rounds in ONE device call.
@@ -370,6 +395,14 @@ def consensus_rounds_block(slab: GraphSlab,
     — the contract above.  ``align_frac=0`` keeps alignment off (the
     driver passes 0 for detectors without content-keyed tie-breaks).
 
+    ``watch0`` (traced bool) and ``noop0`` (traced int32[2]) gate the
+    budget early-stop: the block stops at a budget-starved round only
+    when the host would act on it — auto_grow on, and the overflow
+    exceeding the levels of the last no-op re-derivation (noop0; (-1,-1)
+    = none).  Without the gate a persistently-stale run (--no-grow, or a
+    histogram whose derived sizing cannot change) would degrade every
+    block to one round (round-4 review).
+
     ``pstate0`` (a ``policy.PolicyState`` of traced int32 scalars) is the
     stagnation state entering the block.  Each in-block round evaluates
     the SAME division-free rules the host driver evaluates between device
@@ -387,11 +420,16 @@ def consensus_rounds_block(slab: GraphSlab,
                           cold=jnp.zeros((block,), bool))
 
     def cond(carry):
-        _, i, conv, _, _, _, _ = carry
-        return (~conv) & (i < block) & (i < max_iters)
+        _, i, conv, _, _, _, _, need = carry
+        # `need` stops the block at a budget-starved round (after it is
+        # recorded): the host re-derives the candidate budgets and the
+        # next block runs with complete rows.  Per-round execution
+        # evaluates the identical rule after each round, so fused and
+        # unfused trajectories re-size at the same round.
+        return (~conv) & (~need) & (i < block) & (i < max_iters)
 
     def body(carry):
-        slab, i, _, buf, labels, aligned, pst = carry
+        slab, i, _, buf, labels, aligned, pst, _ = carry
         k = prng.stream(key, prng.STREAM_ROUND, start_round + i)
         if warm:
             # `aligned` is exactly "this round will run aligned"
@@ -408,7 +446,8 @@ def consensus_rounds_block(slab: GraphSlab,
                     return consensus_round(
                         s, kk, detect=d, n_p=n_p, tau=tau, delta=delta,
                         n_closure=n_closure, init_labels=sing,
-                        align=False, sampler=sampler)
+                        align=False, sampler=sampler,
+                        closure_tau=closure_tau)
                 return go
 
             def run_cold(op):
@@ -426,7 +465,7 @@ def consensus_rounds_block(slab: GraphSlab,
                 return consensus_round(
                     s, kk, detect=detect_warm, n_p=n_p, tau=tau,
                     delta=delta, n_closure=n_closure, init_labels=lab,
-                    align=al, sampler=sampler)
+                    align=al, sampler=sampler, closure_tau=closure_tau)
 
             slab, labels, st = jax.lax.cond(
                 cold, run_cold, run_warm, (slab, k, labels, aligned))
@@ -435,7 +474,7 @@ def consensus_rounds_block(slab: GraphSlab,
             slab, labels, st = consensus_round(
                 slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
                 n_closure=n_closure, init_labels=None, align=False,
-                sampler=sampler)
+                sampler=sampler, closure_tau=closure_tau)
             st = st._replace(cold=jnp.bool_(True))
         # fold the round into the carried stagnation state — the same
         # policy.observe the host's record() applies, so fused and
@@ -447,14 +486,19 @@ def consensus_rounds_block(slab: GraphSlab,
             aligned = policy.align_now(jnp, align_frac, pst)
         else:
             aligned = jnp.bool_(False)
-        return (slab, i + 1, st.converged, buf, labels, aligned, pst)
+        need = policy.budgets_stale(jnp, st.n_overflow, st.n_hub_overflow,
+                                    slab.d_cap, slab.hub_cap,
+                                    slab.n_nodes) & \
+            jnp.asarray(watch0) & \
+            ((st.n_overflow > noop0[0]) | (st.n_hub_overflow > noop0[1]))
+        return (slab, i + 1, st.converged, buf, labels, aligned, pst, need)
 
     pst0 = policy.PolicyState(*(jnp.asarray(v, jnp.int32)
                                 for v in pstate0))
-    slab, done, _, buf, labels, _, _ = jax.lax.while_loop(
+    slab, done, _, buf, labels, _, _, _ = jax.lax.while_loop(
         cond, body,
         (slab, jnp.int32(0), jnp.bool_(False), empty_stats(), labels0,
-         jnp.asarray(align0, bool), pst0))
+         jnp.asarray(align0, bool), pst0, jnp.bool_(False)))
     return slab, done, buf, labels
 
 
@@ -463,26 +507,28 @@ def _jitted_rounds_block(detect: Detector, detect_warm: Detector,
                          detect_refresh: Detector, n_p: int,
                          tau: float, delta: float, n_closure: int,
                          block: int, warm: bool, align_frac: float = 0.0,
-                         sampler: str = "scatter"):
+                         sampler: str = "scatter",
+                         closure_tau: Optional[float] = None):
     return jax.jit(functools.partial(
         consensus_rounds_block, detect=detect, detect_warm=detect_warm,
         detect_refresh=detect_refresh, n_p=n_p, tau=tau, delta=delta,
         n_closure=n_closure, block=block, warm=warm,
-        align_frac=align_frac, sampler=sampler))
+        align_frac=align_frac, sampler=sampler, closure_tau=closure_tau))
 
 
 @functools.lru_cache(maxsize=128)
 def _jitted_tail(n_p: int, tau: float, delta: float, n_closure: int,
-                 mesh=None, sampler: str = "scatter"):
+                 mesh=None, sampler: str = "scatter",
+                 closure_tau: Optional[float] = None):
     if mesh is not None:
         from fastconsensus_tpu.ops import sharded_tail as stail
 
         return jax.jit(functools.partial(
             stail.sharded_consensus_tail, n_p=n_p, tau=tau, delta=delta,
-            n_closure=n_closure, mesh=mesh))
+            n_closure=n_closure, mesh=mesh, closure_tau=closure_tau))
     return jax.jit(functools.partial(
         consensus_tail, n_p=n_p, tau=tau, delta=delta, n_closure=n_closure,
-        sampler=sampler))
+        sampler=sampler, closure_tau=closure_tau))
 
 
 def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
@@ -581,6 +627,94 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
     return jnp.concatenate(parts, axis=0)[:n_p]
 
 
+def _resume_from_checkpoint(checkpoint_path: str, slab: GraphSlab,
+                            config: ConsensusConfig, warm: bool,
+                            sampler: str, key: jax.Array):
+    """Load and validate a checkpoint for ``run_consensus``.
+
+    Returns ``(slab, start_round, key, prior_history, cur_labels,
+    measured_member_s, resumed_converged, sampler)``.  Rejects checkpoints
+    from a different run configuration: resuming a tau/n_p/algorithm/graph
+    mismatch would silently mix semantics (weights are co-membership
+    counts out of the *saved* n_p).
+    """
+    from fastconsensus_tpu.utils import checkpoint as ckpt
+
+    in_nodes, in_cap = slab.n_nodes, slab.capacity
+    in_hyb, in_hub = slab.d_hyb, slab.hub_cap
+    slab, start_round, key_data, prior_history, extra = \
+        ckpt.load_checkpoint(checkpoint_path)
+    if extra.pop("_legacy_v1", False) and (in_hyb or in_hub):
+        # v1 checkpoints predate hybrid sizing in the metadata; loading
+        # them with d_hyb=0 would flip select_move_path hybrid -> hash
+        # on resume (different lowering => different labels).  The
+        # sizing is a deterministic function of the input degree
+        # histogram, so the caller's freshly packed slab carries the
+        # original run's exact values — inherit them.
+        _logger.info(
+            "migrating v1 checkpoint: restoring hybrid sizing "
+            "d_hyb=%d hub_cap=%d from the input pack", in_hyb, in_hub)
+        slab = dataclasses.replace(slab, d_hyb=in_hyb, hub_cap=in_hub)
+    if extra.get("closure_sampler") is None:
+        # pre-r4 checkpoints predate the sampler knob; every such run
+        # used the scatter engine.  Continuing under "auto" must keep
+        # drawing the wedges the run was started with (an explicit
+        # --closure-sampler csr still fails the mismatch check below).
+        extra["closure_sampler"] = "scatter"
+        if config.closure_sampler == "auto":
+            _logger.info(
+                "checkpoint predates closure_sampler; continuing with "
+                "the scatter engine it was written with")
+            sampler = "scatter"
+    cur_labels = None
+    if warm and extra.get("_labels") is not None:
+        cur_labels = jnp.asarray(extra["_labels"])
+    measured_member_s = extra.get("member_seconds") or None
+    key = jax.random.wrap_key_data(jnp.asarray(key_data))
+    saved = {k: extra.get(k) for k in
+             ("algorithm", "n_p", "tau", "delta", "gamma",
+              "warm_start", "align_frac", "closure_sampler")}
+    # closure_tau's legitimate default IS None, so absence must be
+    # distinguished from a saved None by key presence (a pre-knob
+    # checkpoint tolerates any requested value the other keys would;
+    # a checkpoint that SAVED no-bar must reject a resumed bar).
+    if "closure_tau" in extra and extra["closure_tau"] != \
+            config.closure_tau:
+        raise ValueError(
+            f"checkpoint {checkpoint_path} was written with closure_tau="
+            f"{extra['closure_tau']}; resuming with "
+            f"{config.closure_tau} would mix insert semantics")
+    want = {"algorithm": config.algorithm, "n_p": config.n_p,
+            "tau": config.tau, "delta": config.delta,
+            "gamma": config.gamma, "warm_start": config.warm_start,
+            "align_frac": config.align_frac,
+            "closure_sampler": sampler}
+    mismatch = {k: (saved[k], want[k]) for k in want
+                if saved[k] is not None and saved[k] != want[k]}
+    if slab.n_nodes != in_nodes:
+        mismatch["graph"] = (slab.n_nodes, in_nodes)
+    elif slab.capacity < in_cap:
+        # The caller asked for more room than the checkpoint has
+        # (e.g. --capacity raised after watching growth recompiles):
+        # honor it — growth is result-preserving (graph.grow_slab).
+        from fastconsensus_tpu.graph import grow_slab
+
+        _logger.info("growing resumed slab capacity %d -> %d to honor "
+                     "the requested pack size", slab.capacity, in_cap)
+        slab = grow_slab(slab, in_cap)
+    elif slab.capacity > in_cap:
+        # Legitimate trace of mid-run auto-growth; keep it.
+        _logger.info("resuming with auto-grown slab capacity %d "
+                     "(freshly packed: %d)", slab.capacity, in_cap)
+    if mismatch:
+        raise ValueError(
+            f"checkpoint {checkpoint_path} was written by a different "
+            f"run configuration: {mismatch} (saved, requested)")
+    resumed_converged = bool(extra.get("converged", False))
+    return (slab, start_round, key, prior_history, cur_labels,
+            measured_member_s, resumed_converged, sampler)
+
+
 class ConsensusResult(NamedTuple):
     partitions: List[np.ndarray]   # n_p final label vectors, compact ids
     graph: GraphSlab               # converged consensus graph
@@ -627,6 +761,11 @@ def run_consensus(slab: GraphSlab,
         raise ValueError(
             f"closure_sampler={config.closure_sampler!r}: expected "
             f"'auto', 'csr' or 'scatter'")
+    if config.closure_tau is not None and \
+            not 0.0 <= config.closure_tau <= 1.0:
+        raise ValueError(
+            f"closure_tau={config.closure_tau} out of range; allowed "
+            f"values are 0..1 (or None to disable)")
     if not 0.0 <= config.align_frac <= 1.0:
         # out-of-range values would silently disable (or saturate)
         # alignment (ADVICE r3)
@@ -677,77 +816,16 @@ def run_consensus(slab: GraphSlab,
     measured_member_s: Optional[float] = None
     measured_in_process = False
 
-    start_round = 0
-    prior_history: List[dict] = []
-    resumed_converged = False
     if resume and checkpoint_path is not None and \
             os.path.exists(checkpoint_path):
-        from fastconsensus_tpu.utils import checkpoint as ckpt
-
-        in_nodes, in_cap = slab.n_nodes, slab.capacity
-        in_hyb, in_hub = slab.d_hyb, slab.hub_cap
-        slab, start_round, key_data, prior_history, extra = \
-            ckpt.load_checkpoint(checkpoint_path)
-        if extra.pop("_legacy_v1", False) and (in_hyb or in_hub):
-            # v1 checkpoints predate hybrid sizing in the metadata; loading
-            # them with d_hyb=0 would flip select_move_path hybrid -> hash
-            # on resume (different lowering => different labels).  The
-            # sizing is a deterministic function of the input degree
-            # histogram, so the caller's freshly packed slab carries the
-            # original run's exact values — inherit them.
-            _logger.info(
-                "migrating v1 checkpoint: restoring hybrid sizing "
-                "d_hyb=%d hub_cap=%d from the input pack", in_hyb, in_hub)
-            slab = dataclasses.replace(slab, d_hyb=in_hyb, hub_cap=in_hub)
-        if extra.get("closure_sampler") is None:
-            # pre-r4 checkpoints predate the sampler knob; every such run
-            # used the scatter engine.  Continuing under "auto" must keep
-            # drawing the wedges the run was started with (an explicit
-            # --closure-sampler csr still fails the mismatch check below).
-            extra["closure_sampler"] = "scatter"
-            if config.closure_sampler == "auto":
-                _logger.info(
-                    "checkpoint predates closure_sampler; continuing with "
-                    "the scatter engine it was written with")
-                sampler = "scatter"
-        if warm and extra.get("_labels") is not None:
-            cur_labels = jnp.asarray(extra["_labels"])
-        measured_member_s = extra.get("member_seconds") or None
-        key = jax.random.wrap_key_data(jnp.asarray(key_data))
-        # Reject checkpoints from a different run configuration: resuming a
-        # tau/n_p/algorithm/graph mismatch would silently mix semantics
-        # (weights are co-membership counts out of the *saved* n_p).
-        saved = {k: extra.get(k) for k in
-                 ("algorithm", "n_p", "tau", "delta", "gamma",
-                  "warm_start", "align_frac", "closure_sampler")}
-        want = {"algorithm": config.algorithm, "n_p": config.n_p,
-                "tau": config.tau, "delta": config.delta,
-                "gamma": config.gamma, "warm_start": config.warm_start,
-                "align_frac": config.align_frac,
-                "closure_sampler": sampler}
-        mismatch = {k: (saved[k], want[k]) for k in want
-                    if saved[k] is not None and saved[k] != want[k]}
-        if slab.n_nodes != in_nodes:
-            mismatch["graph"] = (slab.n_nodes, in_nodes)
-        elif slab.capacity < in_cap:
-            # The caller asked for more room than the checkpoint has
-            # (e.g. --capacity raised after watching growth recompiles):
-            # honor it — growth is result-preserving (graph.grow_slab).
-            from fastconsensus_tpu.graph import grow_slab
-
-            _logger.info("growing resumed slab capacity %d -> %d to honor "
-                         "the requested pack size", slab.capacity, in_cap)
-            slab = grow_slab(slab, in_cap)
-        elif slab.capacity > in_cap:
-            # Legitimate trace of mid-run auto-growth; keep it.
-            _logger.info("resuming with auto-grown slab capacity %d "
-                         "(freshly packed: %d)", slab.capacity, in_cap)
-        if mismatch:
-            raise ValueError(
-                f"checkpoint {checkpoint_path} was written by a different "
-                f"run configuration: {mismatch} (saved, requested)")
-        resumed_converged = bool(extra.get("converged", False))
+        (slab, start_round, key, prior_history, cur_labels,
+         measured_member_s, resumed_converged, sampler) = \
+            _resume_from_checkpoint(checkpoint_path, slab, config, warm,
+                                    sampler, key)
     else:
+        start_round = 0
+        prior_history = []
+        resumed_converged = False
         # weights <- 1.0 at loop start (fc:135-136); input weights are
         # ignored, matching the reference (documented in utils/io.py).
         slab = slab.with_weights(jnp.where(slab.alive, 1.0, 0.0))
@@ -844,7 +922,7 @@ def run_consensus(slab: GraphSlab,
                 (config.algorithm, config.n_p, config.tau, config.delta,
                  config.seed, config.max_rounds, slab.n_nodes,
                  slab.cap_hint or slab.capacity, config.gamma, warm,
-                 config.align_frac, sampler,
+                 config.align_frac, sampler, config.closure_tau,
                  tuple(mesh.shape.items()) if mesh is not None else None)
             ).encode()).hexdigest()[:10]
         forced = None
@@ -880,7 +958,7 @@ def run_consensus(slab: GraphSlab,
                 detect, detect_warm, detect_refresh, config.n_p,
                 config.tau, config.delta, n_closure, fused_block, warm,
                 config.align_frac if (warm and align_ok) else 0.0,
-                sampler)
+                sampler, config.closure_tau)
 
     # Executable identities that already ran at least once since the last
     # setup: their next call is compile-free, so its wall time is an honest
@@ -976,6 +1054,53 @@ def run_consensus(slab: GraphSlab,
             return False
         return bool(policy.align_now(np, config.align_frac, pstate))
 
+    def maybe_regrow_budgets() -> None:
+        """Re-derive the dense/hybrid move-candidate budgets from the LIVE
+        degree histogram when the last round's overflow breached
+        policy.budgets_stale (closure densifies the graph past the
+        pack-time sizing; measured on lfr100k the hub overflow grew 34k ->
+        3.26M over 8 rounds while convergence regressed — VERDICT r3
+        Weak #4).  Only ever called at the top of a loop iteration (a
+        mid-round re-setup nulls in-flight executables, same contract as
+        maybe_resize).  The sizing is a pure function of slab content
+        (graph.derive_*_sizing), so a killed-and-resumed run re-derives
+        the identical budgets at the identical round."""
+        nonlocal slab, budget_noop
+        if not config.auto_grow or not history:
+            return
+        h = history[-1]
+        if budget_noop is not None and \
+                h["n_overflow"] <= budget_noop[0] and \
+                h["n_hub_overflow"] <= budget_noop[1]:
+            return
+        if not bool(policy.budgets_stale(
+                np, h["n_overflow"], h["n_hub_overflow"], slab.d_cap,
+                slab.hub_cap, slab.n_nodes)):
+            return
+        from fastconsensus_tpu.graph import (derive_dense_sizing,
+                                             derive_hybrid_sizing)
+
+        deg = np.asarray(jax.device_get(slab.degrees())).astype(np.int64)
+        n_alive = int(np.asarray(jax.device_get(slab.num_alive())))
+        new_d_cap = derive_dense_sizing(deg, slab.n_nodes)
+        new_hyb, new_hub = derive_hybrid_sizing(deg, slab.n_nodes, n_alive)
+        if (new_d_cap, new_hyb, new_hub) == \
+                (slab.d_cap, slab.d_hyb, slab.hub_cap):
+            # re-derivation cannot help at these overflow levels; suppress
+            # until starvation worsens (and let fused blocks run full)
+            budget_noop = (h["n_overflow"], h["n_hub_overflow"])
+            return
+        budget_noop = None
+        _logger.warning(
+            "move-candidate budgets starved (overflow %d dense / %d hub): "
+            "re-deriving from the live degree histogram: d_cap %d -> %d, "
+            "d_hyb %d -> %d, hub_cap %d -> %d (one recompile)",
+            h["n_overflow"], h["n_hub_overflow"], slab.d_cap, new_d_cap,
+            slab.d_hyb, new_hyb, slab.hub_cap, new_hub)
+        slab = dataclasses.replace(slab, d_cap=new_d_cap, d_hyb=new_hyb,
+                                   hub_cap=new_hub)
+        setup_executables()
+
     def grow_and_replay(pre_slab: GraphSlab, dropped: int) -> None:
         """Self-sizing slab: grow from the *pre-round* state and let the
         caller replay the round.  Replay is deterministic (same round key,
@@ -1031,6 +1156,11 @@ def run_consensus(slab: GraphSlab,
     # record(); the single source both round_mode and the fused block's
     # carry seed read.
     pstate = policy.state_from_history(history)
+    # Budget-regrowth suppression: overflow levels at the last re-derivation
+    # that produced UNCHANGED sizing (None = none).  Until the overflow
+    # worsens past these levels, re-checking cannot help and would only
+    # stop fused blocks + re-read the degree histogram every round.
+    budget_noop: Optional[Tuple[int, int]] = None
     converged = resumed_converged
     rounds = start_round
     end_round = start_round if resumed_converged else config.max_rounds
@@ -1052,15 +1182,18 @@ def run_consensus(slab: GraphSlab,
     r = start_round
     while r < end_round:
         maybe_resize()
+        maybe_regrow_budgets()
         pre_slab = slab
         if fused_block > 1:
             labels0 = cur_labels if warm else jnp.zeros(
                 (config.n_p, slab.n_nodes), jnp.int32)
             t0 = time.perf_counter()
+            noop = budget_noop if budget_noop is not None else (-1, -1)
             slab, done, buf, new_labels = block_fn(
                 slab, key, labels0, jnp.int32(r), jnp.int32(end_round - r),
                 jnp.bool_(align_now(r)),
-                policy.PolicyState(*(jnp.int32(v) for v in pstate)))
+                policy.PolicyState(*(jnp.int32(v) for v in pstate)),
+                jnp.bool_(config.auto_grow), jnp.asarray(noop, jnp.int32))
             done = int(done)
             buf = jax.device_get(buf)
             dt = time.perf_counter() - t0
@@ -1132,7 +1265,8 @@ def run_consensus(slab: GraphSlab,
                                 call_s=measured_member_s * members)
                 slab, stats = _jitted_tail(
                     config.n_p, config.tau, config.delta, n_closure,
-                    mesh, sampler)(slab, labels, k_closure)
+                    mesh, sampler, config.closure_tau)(
+                    slab, labels, k_closure)
                 stats = jax.device_get(stats)
                 while config.auto_grow and int(stats.n_dropped) > 0:
                     # capacity only matters after detection: replay just
@@ -1143,7 +1277,8 @@ def run_consensus(slab: GraphSlab,
                     grow_and_replay(pre_slab, int(stats.n_dropped))
                     slab, stats = _jitted_tail(
                         config.n_p, config.tau, config.delta, n_closure,
-                        mesh, sampler)(slab, labels, k_closure)
+                        mesh, sampler, config.closure_tau)(
+                        slab, labels, k_closure)
                     stats = jax.device_get(stats)
                 if warm:
                     cur_labels = labels
@@ -1154,7 +1289,8 @@ def run_consensus(slab: GraphSlab,
                                 "warm": detect_warm}[mode]
                 round_fn = _jitted_round(  # lru-cached: cheap per round
                     round_detect, config.n_p, config.tau,
-                    config.delta, n_closure, ensemble_sharding, sampler)
+                    config.delta, n_closure, ensemble_sharding, sampler,
+                    config.closure_tau)
                 t0 = time.perf_counter()
                 if warm:
                     # align passed traced: flipping it mid-run reuses the
@@ -1207,12 +1343,17 @@ def run_consensus(slab: GraphSlab,
                            "warm_start": config.warm_start,
                            "align_frac": config.align_frac,
                            "closure_sampler": sampler,
+                           "closure_tau": config.closure_tau,
                            "member_seconds": measured_member_s,
                            "converged": converged},
                     labels=(np.asarray(cur_labels) if warm else None))
             if converged:
                 break
 
+    # the final re-detection deserves complete candidate rows too (and the
+    # re-derivation is content-pure, so a killed-and-restarted process
+    # reaches the same sizing and the same _final chunk fingerprints)
+    maybe_regrow_budgets()
     final_keys = prng.partition_keys(
         prng.stream(key, prng.STREAM_FINAL), config.n_p)
     # Warm-start the final re-detection too: on a converged consensus graph

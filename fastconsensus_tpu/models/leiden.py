@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -91,9 +92,13 @@ def leiden_single(slab: GraphSlab, key: jax.Array,
     return lvl[jnp.clip(refined, 0, n - 1)]
 
 
-def make_leiden(max_sweeps: int = 32, gamma: float = 1.0,
+def make_leiden(max_sweeps: Optional[int] = None, gamma: float = 1.0,
                 theta: float = 0.01) -> Detector:
-    from fastconsensus_tpu.models.louvain import warm_sweep_budget
+    from fastconsensus_tpu.models.louvain import (cold_sweep_budget,
+                                                  warm_sweep_budget)
+
+    if max_sweeps is None:
+        max_sweeps = cold_sweep_budget()
 
     det = ensemble(functools.partial(leiden_single, max_sweeps=max_sweeps,
                                      gamma=gamma, theta=theta))

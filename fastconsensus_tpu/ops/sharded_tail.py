@@ -252,7 +252,8 @@ def _singleton_candidates(slab: GraphSlab, prev: GraphSlab):
 
 def _tail_local(slab: GraphSlab, labels: jax.Array, k_closure: jax.Array,
                 *, n_p: int, tau: float, delta: float, n_closure: int,
-                cap_hint: int, hybrid_gate: bool):
+                cap_hint: int, hybrid_gate: bool,
+                closure_tau=None):
     """The per-shard tail program; see the module docstring."""
     from fastconsensus_tpu.consensus import RoundStats
 
@@ -271,6 +272,11 @@ def _tail_local(slab: GraphSlab, labels: jax.Array, k_closure: jax.Array,
         n0 = _num_alive(slab)
         cu, cv, cvalid = _sample_wedges(k_closure, slab, n_closure)
         cw = _comembership(labels, cu, cv)
+        if closure_tau is not None:
+            # threshold-at-insert (ConsensusConfig.closure_tau); same rule
+            # as consensus_tail — parity contract
+            cvalid = cvalid & (cw >= jnp.float32(closure_tau) *
+                               jnp.float32(n_p))
         slab, dropped = _insert_edges(slab, cu, cv, cw, cvalid, cap_hint)
         n1 = _num_alive(slab)
         su, sv, sw, svalid = _singleton_candidates(slab, prev)
@@ -312,7 +318,8 @@ def _tail_local(slab: GraphSlab, labels: jax.Array, k_closure: jax.Array,
 
 def sharded_consensus_tail(slab: GraphSlab, labels: jax.Array,
                            k_closure: jax.Array, n_p: int, tau: float,
-                           delta: float, n_closure: int, mesh
+                           delta: float, n_closure: int, mesh,
+                           closure_tau=None
                            ) -> Tuple[GraphSlab, "object"]:
     """Run the tail edge-locally over ``mesh`` (axes "p" x "e").
 
@@ -327,7 +334,8 @@ def sharded_consensus_tail(slab: GraphSlab, labels: jax.Array,
         functools.partial(
             _tail_local, n_p=n_p, tau=tau, delta=delta,
             n_closure=n_closure, cap_hint=_cap_hint(slab),
-            hybrid_gate=select_move_path(slab) == "hybrid"),
+            hybrid_gate=select_move_path(slab) == "hybrid",
+            closure_tau=closure_tau),
         mesh=mesh,
         in_specs=(P(EDGE_AXIS), P(ENSEMBLE_AXIS, None), P()),
         out_specs=(P(EDGE_AXIS), P()),

@@ -22,3 +22,27 @@ def env_int(name: str, default: Optional[int] = None) -> Optional[int]:
     except ValueError:
         raise ValueError(
             f"environment variable {name}={raw!r} is not an integer") from None
+
+
+def setup_compile_cache() -> None:
+    """Persistent XLA compilation cache (call before the first jax import).
+
+    The TPU tunnel's remote-compile service costs ~20-60 s per executable;
+    supervised long runs restart the process on stalls and would otherwise
+    re-pay every compile.  Keyed by a host-CPU fingerprint: an XLA:CPU AOT
+    executable loaded on a host with different CPU features aborts the
+    process (see tests/conftest.py).  Shared by bench.py and the CLI.
+    """
+    import hashlib
+    import os
+
+    try:
+        with open("/proc/cpuinfo") as fh:
+            flags = next(line for line in fh if line.startswith("flags"))
+        tag = hashlib.sha1(flags.encode()).hexdigest()[:8]
+    except (OSError, StopIteration):
+        tag = "generic"
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.expanduser(f"~/.cache/fctpu_xla_{tag}"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
