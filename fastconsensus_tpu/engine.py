@@ -1,0 +1,541 @@
+"""The jitted consensus engine: one round, fused round blocks, chunked
+detection.
+
+Everything in this module is device-side program construction — jittable
+functions over the static-shape GraphSlab plus their lru-cached ``jax.jit``
+wrappers.  The host-side loop driver (resume, sizing, stagnation policy,
+checkpointing) lives in ``consensus.py``; the control rules both sides
+share live in ``policy.py``.  Split out of consensus.py in round 4
+(VERDICT r3 Weak #6).
+
+One consensus round (reference ``fast_consensus.py:138-201``):
+
+    detect (vmapped over n_p keys)          fc:148 / :211 / :268-270 / :324-335
+    -> co-membership counts per edge        fc:150-159
+    -> tau-threshold                        fc:163-168
+    -> convergence check                    fc:172 (-> fc:17-37)
+    -> triadic closure (skipped if converged)  fc:175-191
+    -> singleton repair                     fc:193-195
+    -> convergence check                    fc:201
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import time
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fastconsensus_tpu import policy
+from fastconsensus_tpu.graph import GraphSlab
+from fastconsensus_tpu.models.base import Detector
+from fastconsensus_tpu.ops import consensus_ops as cops
+from fastconsensus_tpu.utils import prng
+
+_logger = logging.getLogger("fastconsensus_tpu")
+
+
+class RoundStats(NamedTuple):
+    converged: jax.Array       # bool[]
+    n_alive: jax.Array         # int32[] edges after the round
+    n_unconverged: jax.Array   # int32[] alive edges with 0 < w < n_p
+    n_closure_added: jax.Array # int32[] triadic-closure edges inserted
+    n_repaired: jax.Array      # int32[] singleton-repair edges inserted
+    n_dropped: jax.Array       # int32[] survivors dropped for capacity
+    n_overflow: jax.Array      # int32[] directed edges beyond d_cap, i.e.
+                               # dropped from dense move-candidate rows
+    n_hub_overflow: jax.Array  # int32[] hub directed edges beyond hub_cap,
+                               # i.e. dropped from the hybrid path's hashed
+                               # move candidates (ops/dense_adj.build_hybrid)
+    cold: jax.Array            # bool[] this round ran full-sweep singleton
+                               # -start detection (round 0 / cold mode /
+                               # stagnation refresh); drives the stall
+                               # reset and is recorded in history
+
+
+def consensus_tail(slab: GraphSlab,
+                   labels: jax.Array,
+                   k_closure: jax.Array,
+                   n_p: int,
+                   tau: float,
+                   delta: float,
+                   n_closure: int,
+                   sampler: str = "scatter",
+                   closure_tau: Optional[float] = None
+                   ) -> Tuple[GraphSlab, RoundStats]:
+    """Everything after detection: co-membership -> threshold -> convergence
+    -> closure -> repair.  Jittable; shared by the one-call
+    :func:`consensus_round` and the split-phase driver loop.
+
+    ``sampler`` selects the wedge-sampling lowering (static; see
+    ConsensusConfig.closure_sampler): "csr" is the single-chip fast path,
+    "scatter" the edge-local engine the shard_map tail shares bit-exactly.
+    """
+    counts = cops.comembership_counts(labels, slab.src, slab.dst)
+    prev = slab  # round-start weights; used by singleton repair (fc:194)
+    slab = cops.update_weights(slab, counts, n_p)
+    slab = cops.threshold_weights(slab, tau, n_p)
+    st_mid = cops.convergence_stats(slab, n_p, delta)
+
+    def do_closure(slab):
+        n0 = slab.num_alive()
+        if sampler == "csr":
+            csr = cops.build_csr(slab)
+            cu, cv, cvalid = cops.sample_wedges(k_closure, csr,
+                                                slab.n_nodes, n_closure)
+        else:
+            # sort-free engine: required under an edge-sharded mesh, where
+            # the CSR argsort re-gathers the whole slab
+            # (sample_wedges_scatter docstring)
+            cu, cv, cvalid = cops.sample_wedges_scatter(k_closure, slab,
+                                                        n_closure)
+        cw = cops.comembership_counts(labels, cu, cv)
+        if closure_tau is not None:
+            # threshold-at-insert (ConsensusConfig.closure_tau)
+            cvalid = cvalid & (cw >= jnp.float32(closure_tau) *
+                               jnp.float32(n_p))
+        slab, dropped = cops.insert_edges_hash(slab, cu, cv, cw, cvalid)
+        n1 = slab.num_alive()
+        su, sv, sw, svalid = cops.singleton_candidates(slab, prev)
+        # repair candidates are unique + absent by construction: exact
+        # insert — a reattachment must never be lost to a hash collision
+        slab, dropped2 = cops.insert_edges_hash(slab, su, sv, sw, svalid,
+                                                unique_new=True)
+        return slab, n1 - n0, slab.num_alive() - n1, dropped + dropped2
+
+    def skip_closure(slab):
+        return slab, jnp.int32(0), jnp.int32(0), jnp.int32(0)
+
+    slab, n_closed, n_repaired, n_dropped = jax.lax.cond(
+        st_mid.converged, skip_closure, do_closure, slab)
+    st_end = cops.convergence_stats(slab, n_p, delta)
+    if slab.d_cap > 0:
+        # candidates the dense kernels will not see next round (ops/dense_adj)
+        n_overflow = jnp.sum(
+            jnp.maximum(slab.degrees() - slab.d_cap, 0).astype(jnp.int32))
+    else:
+        n_overflow = jnp.int32(0)
+    from fastconsensus_tpu.models.louvain import select_move_path
+    if select_move_path(slab) == "hybrid":
+        # same count build_hybrid would drop next round: total degree of
+        # hub nodes beyond the static prefix budget (ADVICE round 2 —
+        # consensus rounds can outgrow the pack-time hub_cap silently).
+        # Gated on the *selected* path: slabs can carry hybrid sizing yet
+        # take the matmul/dense path, where nothing is ever dropped.
+        deg = slab.degrees()
+        hub_mass = jnp.sum(jnp.where(deg > slab.d_hyb, deg, 0)
+                           .astype(jnp.int32))
+        n_hub_overflow = jnp.maximum(hub_mass - slab.hub_cap, 0)
+    else:
+        n_hub_overflow = jnp.int32(0)
+    stats = RoundStats(
+        converged=st_mid.converged | st_end.converged,
+        n_alive=st_end.n_alive,
+        n_unconverged=st_end.n_unconverged,
+        n_closure_added=n_closed,
+        n_repaired=n_repaired,
+        n_dropped=n_dropped,
+        n_overflow=n_overflow,
+        n_hub_overflow=n_hub_overflow,
+        cold=jnp.bool_(False),  # the caller (driver / block body) knows
+    )
+    return slab, stats
+
+
+def _maybe_align_keys(keys: jax.Array, align) -> jax.Array:
+    """Give every ensemble member member 0's key when ``align`` is true.
+
+    ``align`` may be a Python bool (static short-circuit) or a traced bool
+    scalar (both variants live in one executable — select on the raw key
+    data; typed PRNG key arrays have no jnp.where).
+    """
+    if isinstance(align, bool) and not align:
+        return keys
+    aligned = keys[jnp.zeros((keys.shape[0],), jnp.int32)]
+    return jax.random.wrap_key_data(
+        jnp.where(align, jax.random.key_data(aligned),
+                  jax.random.key_data(keys)))
+
+
+def consensus_round(slab: GraphSlab,
+                    key: jax.Array,
+                    detect: Detector,
+                    n_p: int,
+                    tau: float,
+                    delta: float,
+                    n_closure: int,
+                    ensemble_sharding=None,
+                    init_labels: Optional[jax.Array] = None,
+                    align: bool = False,
+                    sampler: str = "scatter",
+                    closure_tau: Optional[float] = None
+                    ) -> Tuple[GraphSlab, jax.Array, RoundStats]:
+    """One full consensus round.  Jittable; all shapes static.
+
+    Returns (next_slab, labels[n_p, N], stats).  ``n_closure`` is L, the
+    original edge count (the reference re-reads it from the *input* graph
+    every round, fc:144/:175 — so it is static).
+
+    ``init_labels`` ([n_p, N]) warm-starts detection from the previous
+    round's labels — the consensus graph changes little between rounds, so
+    warm members converge in a few sweeps instead of re-deriving the
+    partition from singletons every round (the driver threads this;
+    None = from-scratch, the reference's only mode, fc:148).
+
+    ``align`` shares member 0's detection key with every member (endgame
+    tie-break alignment, ConsensusConfig.align_frac; requires warm
+    init_labels to keep members distinct).  May be a traced bool scalar —
+    flipping it never recompiles the round.
+
+    ``ensemble_sharding`` (a ``NamedSharding`` with spec ``P("p")``) pins the
+    per-partition keys and labels to the mesh's ensemble axis; XLA then runs
+    each chip's shard of the ensemble locally and contracts the n_p axis of
+    the co-membership count with one ``psum`` — the round's only collective.
+    """
+    k_detect, k_closure = jax.random.split(key)
+    keys = _maybe_align_keys(prng.partition_keys(k_detect, n_p), align)
+    if ensemble_sharding is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        keys = jax.lax.with_sharding_constraint(keys, ensemble_sharding)
+        labels_sharding = NamedSharding(
+            ensemble_sharding.mesh,
+            PartitionSpec(*ensemble_sharding.spec, None))
+        if init_labels is not None:
+            init_labels = jax.lax.with_sharding_constraint(
+                init_labels, labels_sharding)
+            raw = detect(slab, keys, init_labels)
+        else:
+            raw = detect(slab, keys)
+        labels = jax.lax.with_sharding_constraint(raw, labels_sharding)
+    elif init_labels is not None:
+        labels = detect(slab, keys, init_labels)
+    else:
+        labels = detect(slab, keys)
+    if ensemble_sharding is not None:
+        # explicit edge-local tail: GSPMD re-gathers the tail's scatters
+        # and concatenates capacity-wide (ops/sharded_tail.py docstring);
+        # bit-identical to the unsharded tail below
+        from fastconsensus_tpu.ops import sharded_tail as stail
+
+        slab, stats = stail.sharded_consensus_tail(
+            slab, labels, k_closure, n_p, tau, delta, n_closure,
+            ensemble_sharding.mesh, closure_tau=closure_tau)
+    else:
+        slab, stats = consensus_tail(slab, labels, k_closure, n_p, tau,
+                                     delta, n_closure, sampler=sampler,
+                                     closure_tau=closure_tau)
+    return slab, labels, stats
+
+
+@functools.lru_cache(maxsize=128)
+def _jitted_round(detect: Detector, n_p: int, tau: float, delta: float,
+                  n_closure: int, ensemble_sharding,
+                  sampler: str = "scatter",
+                  closure_tau: Optional[float] = None):
+    """Cache jitted round steps across run_consensus calls.
+
+    ``jax.jit`` keys its executable cache on the *function object*; wrapping a
+    fresh ``functools.partial`` per run would recompile every round step on
+    every call (measured: ~18s/run on the TPU tunnel).  Detectors from the
+    registry are module-level singletons, so they hash stably here.
+    ``align`` stays a call-time (traced) argument for the same reason.
+    """
+    return jax.jit(functools.partial(
+        consensus_round, detect=detect, n_p=n_p, tau=tau, delta=delta,
+        n_closure=n_closure, ensemble_sharding=ensemble_sharding,
+        sampler=sampler, closure_tau=closure_tau))
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_detect(detect: Detector):
+    return jax.jit(detect)
+
+
+def consensus_rounds_block(slab: GraphSlab,
+                           key: jax.Array,
+                           labels0: jax.Array,
+                           start_round: jax.Array,
+                           max_iters: jax.Array,
+                           align0: jax.Array,
+                           pstate0: policy.PolicyState,
+                           watch0: jax.Array,
+                           noop0: jax.Array,
+                           detect: Detector,
+                           detect_warm: Detector,
+                           detect_refresh: Detector,
+                           n_p: int,
+                           tau: float,
+                           delta: float,
+                           n_closure: int,
+                           block: int,
+                           warm: bool,
+                           align_frac: float = 0.0,
+                           sampler: str = "scatter",
+                           closure_tau: Optional[float] = None
+                           ) -> Tuple[GraphSlab, jax.Array, RoundStats,
+                                      jax.Array]:
+    """Up to ``min(block, max_iters)`` consensus rounds in ONE device call.
+
+    On small graphs a round's device time is a few hundred ms, so the
+    per-round host round-trip (dispatch + stats readback over the TPU
+    tunnel) dominates the driver loop; a ``lax.while_loop`` over whole
+    rounds amortizes it ``block``-fold.  Stops early on delta-convergence.
+    ``max_iters`` is traced (the driver's remaining-round budget never
+    triggers a recompile).  Returns (slab, n_rounds_done, stacked
+    stats[block], last_labels); stats entries past n_rounds_done are garbage
+    and must be ignored.  ``key`` is the run key: per-round keys are derived
+    from (key, start_round + i) exactly as the one-round driver derives
+    them, so block size never changes results.
+
+    ``labels0`` [n_p, N] seeds the first round's detection when ``warm``
+    (consensus_round init_labels); each later round warm-starts from its
+    predecessor's labels via the loop carry.  Absolute round 0 runs the
+    full-sweep ``detect``; later rounds the capped-sweep ``detect_warm``
+    (an in-block ``lax.cond``; see louvain.warm_sweep_budget).  With
+    ``warm=False`` the carry still tracks labels (for the caller's next
+    block / final detection) but detection always cold-starts via
+    ``detect``.
+
+    ``align0`` (traced bool) is the endgame-alignment state entering the
+    block (ConsensusConfig.align_frac); each in-block round re-derives it
+    from its own stats, so fused and per-round execution stay bit-identical
+    — the contract above.  ``align_frac=0`` keeps alignment off (the
+    driver passes 0 for detectors without content-keyed tie-breaks).
+
+    ``watch0`` (traced bool) and ``noop0`` (traced int32[2]) gate the
+    budget early-stop: the block stops at a budget-starved round only
+    when the host would act on it — auto_grow on, and the overflow
+    exceeding the levels of the last no-op re-derivation (noop0; (-1,-1)
+    = none).  Without the gate a persistently-stale run (--no-grow, or a
+    histogram whose derived sizing cannot change) would degrade every
+    block to one round (round-4 review).
+
+    ``pstate0`` (a ``policy.PolicyState`` of traced int32 scalars) is the
+    stagnation state entering the block.  Each in-block round evaluates
+    the SAME division-free rules the host driver evaluates between device
+    calls — ``policy.stalled`` (one-step relative progress), ``policy.
+    stale`` (limit cycle) — with ``xp = jnp`` instead of numpy; a firing
+    rule makes the next round re-detect COLD (singleton init, full sweeps,
+    independent keys), and ``policy.observe`` folds each round's stats
+    into the carried state exactly as the host's ``record()`` does.
+    """
+    def empty_stats():
+        z = jnp.zeros((block,), jnp.int32)
+        return RoundStats(converged=jnp.zeros((block,), bool), n_alive=z,
+                          n_unconverged=z, n_closure_added=z, n_repaired=z,
+                          n_dropped=z, n_overflow=z, n_hub_overflow=z,
+                          cold=jnp.zeros((block,), bool))
+
+    def cond(carry):
+        _, i, conv, _, _, _, _, need = carry
+        # `need` stops the block at a budget-starved round (after it is
+        # recorded): the host re-derives the candidate budgets and the
+        # next block runs with complete rows.  Per-round execution
+        # evaluates the identical rule after each round, so fused and
+        # unfused trajectories re-size at the same round.
+        return (~conv) & (~need) & (i < block) & (i < max_iters)
+
+    def body(carry):
+        slab, i, _, buf, labels, aligned, pst, _ = carry
+        k = prng.stream(key, prng.STREAM_ROUND, start_round + i)
+        if warm:
+            # `aligned` is exactly "this round will run aligned"
+            stall = policy.stalled(jnp, delta, pst, aligned)
+            stale = policy.stale(jnp, delta, pst)
+            cold = (start_round + i == 0) | stale | stall
+
+            def run_singleton(d):
+                def go(op):
+                    s, kk, lab, _ = op
+                    sing = jnp.broadcast_to(
+                        jnp.arange(lab.shape[1], dtype=jnp.int32),
+                        lab.shape)
+                    return consensus_round(
+                        s, kk, detect=d, n_p=n_p, tau=tau, delta=delta,
+                        n_closure=n_closure, init_labels=sing,
+                        align=False, sampler=sampler,
+                        closure_tau=closure_tau)
+                return go
+
+            def run_cold(op):
+                # round 0: the theta-randomized base detector (ensemble
+                # diversity); stagnation refresh: the low-variance
+                # refresh variant (models/leiden.py refresh_variant)
+                if detect_refresh is detect:
+                    return run_singleton(detect)(op)
+                return jax.lax.cond(
+                    start_round + i == 0, run_singleton(detect),
+                    run_singleton(detect_refresh), op)
+
+            def run_warm(op):
+                s, kk, lab, al = op
+                return consensus_round(
+                    s, kk, detect=detect_warm, n_p=n_p, tau=tau,
+                    delta=delta, n_closure=n_closure, init_labels=lab,
+                    align=al, sampler=sampler, closure_tau=closure_tau)
+
+            slab, labels, st = jax.lax.cond(
+                cold, run_cold, run_warm, (slab, k, labels, aligned))
+            st = st._replace(cold=cold)
+        else:
+            slab, labels, st = consensus_round(
+                slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
+                n_closure=n_closure, init_labels=None, align=False,
+                sampler=sampler, closure_tau=closure_tau)
+            st = st._replace(cold=jnp.bool_(True))
+        # fold the round into the carried stagnation state — the same
+        # policy.observe the host's record() applies, so fused and
+        # per-round execution see identical rule inputs
+        pst = policy.observe(jnp, pst, st.cold, st.n_unconverged,
+                             st.n_alive)
+        buf = jax.tree.map(lambda b, s: b.at[i].set(s), buf, st)
+        if warm and align_frac > 0:
+            aligned = policy.align_now(jnp, align_frac, pst)
+        else:
+            aligned = jnp.bool_(False)
+        need = policy.budgets_stale(jnp, st.n_overflow, st.n_hub_overflow,
+                                    slab.d_cap, slab.hub_cap,
+                                    slab.n_nodes) & \
+            jnp.asarray(watch0) & \
+            ((st.n_overflow > noop0[0]) | (st.n_hub_overflow > noop0[1]))
+        return (slab, i + 1, st.converged, buf, labels, aligned, pst, need)
+
+    pst0 = policy.PolicyState(*(jnp.asarray(v, jnp.int32)
+                                for v in pstate0))
+    slab, done, _, buf, labels, _, _, _ = jax.lax.while_loop(
+        cond, body,
+        (slab, jnp.int32(0), jnp.bool_(False), empty_stats(), labels0,
+         jnp.asarray(align0, bool), pst0, jnp.bool_(False)))
+    return slab, done, buf, labels
+
+
+@functools.lru_cache(maxsize=128)
+def _jitted_rounds_block(detect: Detector, detect_warm: Detector,
+                         detect_refresh: Detector, n_p: int,
+                         tau: float, delta: float, n_closure: int,
+                         block: int, warm: bool, align_frac: float = 0.0,
+                         sampler: str = "scatter",
+                         closure_tau: Optional[float] = None):
+    return jax.jit(functools.partial(
+        consensus_rounds_block, detect=detect, detect_warm=detect_warm,
+        detect_refresh=detect_refresh, n_p=n_p, tau=tau, delta=delta,
+        n_closure=n_closure, block=block, warm=warm,
+        align_frac=align_frac, sampler=sampler, closure_tau=closure_tau))
+
+
+@functools.lru_cache(maxsize=128)
+def _jitted_tail(n_p: int, tau: float, delta: float, n_closure: int,
+                 mesh=None, sampler: str = "scatter",
+                 closure_tau: Optional[float] = None):
+    if mesh is not None:
+        from fastconsensus_tpu.ops import sharded_tail as stail
+
+        return jax.jit(functools.partial(
+            stail.sharded_consensus_tail, n_p=n_p, tau=tau, delta=delta,
+            n_closure=n_closure, mesh=mesh, closure_tau=closure_tau))
+    return jax.jit(functools.partial(
+        consensus_tail, n_p=n_p, tau=tau, delta=delta, n_closure=n_closure,
+        sampler=sampler, closure_tau=closure_tau))
+
+
+def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
+                    members: int,
+                    cache_dir: Optional[str] = None,
+                    cache_tag: str = "",
+                    init_labels: Optional[jax.Array] = None,
+                    ensemble_sharding=None,
+                    timings: Optional[list] = None) -> jax.Array:
+    """Run detection as ceil(n_p / members) separate device calls.
+
+    Labels stay on device; only the dispatches are split.  Chunks reuse one
+    compiled executable; an uneven remainder compiles a second shape once.
+
+    ``cache_dir``: elastic recovery for long runs.  Each completed chunk's
+    labels are persisted as ``{cache_dir}/{cache_tag}_c{i}.npy``; a
+    restarted run (the TPU tunnel wedges multi-hundred-call sequences, see
+    utils/trace.py notes) skips straight past finished chunks instead of
+    redetecting them.  Results are identical either way — chunk keys are
+    position-derived — *provided the detector is per-key independent*
+    (member i's labels depend only on (slab, keys[i])).  Every ensemble()
+    lift satisfies this; a custom Detector that mixes information across
+    the keys axis would silently change results under chunking (see the
+    Detector protocol docstring).
+    """
+    n_p = keys.shape[0]
+    jd = _jitted_detect(detect)
+
+    def call(ks, init):
+        if ensemble_sharding is not None:
+            # pin each chunk to the mesh's ensemble axis (chunk sizes are
+            # rounded to a multiple of it by setup_executables)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            ks = jax.device_put(ks, ensemble_sharding)
+            if init is not None:
+                init = jax.device_put(init, NamedSharding(
+                    ensemble_sharding.mesh,
+                    PartitionSpec(*ensemble_sharding.spec, None)))
+        return jd(slab, ks) if init is None else jd(slab, ks, init)
+
+    if members >= n_p:
+        return call(keys, init_labels)
+    # Pad to a whole number of equal chunks: one compiled shape for every
+    # call (a ragged remainder would pay a second multi-minute remote
+    # compile for at most `members-1` members of work).
+    n_calls = -(-n_p // members)
+    pad = n_calls * members - n_p
+    if pad:
+        # gather (typed PRNG key arrays don't implement .repeat)
+        idx = jnp.concatenate([jnp.arange(n_p, dtype=jnp.int32),
+                               jnp.full((pad,), n_p - 1, jnp.int32)])
+        keys = keys[idx]
+        if init_labels is not None:
+            init_labels = init_labels[idx]
+    parts = []
+    computed = 0  # chunks actually executed (not cache-loaded) this call
+    for i in range(n_calls):
+        path = None
+        if cache_dir:
+            path = os.path.join(cache_dir, f"{cache_tag}_c{i}.npy")
+            if os.path.exists(path):
+                cached = np.load(path)
+                if cached.shape != (members, slab.n_nodes) or \
+                        cached.dtype != np.int32:
+                    raise ValueError(
+                        f"stale detect-chunk cache {path}: shape "
+                        f"{cached.shape} dtype {cached.dtype}, expected "
+                        f"{(members, slab.n_nodes)} int32; clean the "
+                        f"cache dir")
+                parts.append(jnp.asarray(cached))
+                _logger.debug("detect call %d/%d: loaded from %s",
+                              i + 1, n_calls, path)
+                continue
+        t0 = time.perf_counter()
+        sl = slice(i * members, (i + 1) * members)
+        out = call(keys[sl],
+                   None if init_labels is None else init_labels[sl])
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
+        _logger.debug("detect call %d/%d (%d members): %.1fs",
+                      i + 1, n_calls, members, dt)
+        if timings is not None and computed > 0:
+            # the first *executed* chunk of a new shape pays the compile
+            # (on a cache-assisted restart that may be chunk k, not chunk
+            # 0); later executions measure the pure execute rate (the
+            # quantity call sizing needs)
+            timings.append(dt / members)
+        computed += 1
+        if path is not None:
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:  # np.save would append .npy to tmp
+                np.save(fh, np.asarray(out))
+            os.replace(tmp, path)
+        parts.append(out)
+    return jnp.concatenate(parts, axis=0)[:n_p]
+

@@ -1,0 +1,29 @@
+#!/bin/bash
+# lfr10k A/B matrix (round-4 VERDICT #3/#4), run from the frozen worktree
+# /tmp/fc_ab so live edits cannot change detect-cache fingerprints mid-run.
+set -u
+cd /tmp/fc_ab
+export PYTHONPATH=/tmp/fc_ab:/root/.axon_site
+GRAPH=/root/repo/runs/lfr10k_r4/graph.txt
+BASE=/root/repo/runs/lfr10k_r4
+
+run_variant () {
+  local name="$1"; shift
+  local d="$BASE/$name"
+  mkdir -p "$d"
+  echo "=== variant $name: start $(date +%T)" >> "$BASE/ab.log"
+  local t0=$SECONDS
+  python -m fastconsensus_tpu.utils.supervise --progress "$d/rounds.jsonl" \
+    --stall-seconds 420 -- \
+    python -m fastconsensus_tpu.cli -f "$GRAPH" --alg leiden -np 100 \
+      -t 0.2 -d 0.02 --seed 0 --max-rounds 15 \
+      --checkpoint "$d/ck.npz" --resume --detect-cache "$d/cache" \
+      --trace-jsonl "$d/rounds.jsonl" --out-dir "$d" "$@" \
+      >> "$d/run.log" 2>&1
+  echo "=== variant $name: done $(date +%T) rc=$? wall=$((SECONDS-t0))s" >> "$BASE/ab.log"
+}
+
+run_variant b --closure-tau 0.2
+FCTPU_COLD_SWEEPS=8 run_variant c --closure-tau 0.2
+run_variant a
+echo "=== all done $(date +%T)" >> "$BASE/ab.log"

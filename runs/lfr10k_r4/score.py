@@ -1,0 +1,54 @@
+#!/usr/bin/env python
+"""Score lfr10k A/B variants: NMI vs planted truth + trajectory summary."""
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+BASE = os.path.dirname(os.path.abspath(__file__))
+
+
+def score(variant: str) -> None:
+    from fastconsensus_tpu.utils.metrics import nmi
+
+    d = os.path.join(BASE, variant)
+    truth = np.load(os.path.join(BASE, "truth.npy"))
+    mdirs = glob.glob(os.path.join(d, "memberships_*"))
+    rows = []
+    if os.path.exists(os.path.join(d, "rounds.jsonl")):
+        with open(os.path.join(d, "rounds.jsonl")) as fh:
+            rows = [json.loads(ln) for ln in fh if ln.strip()]
+    out = {"variant": variant, "rounds": len({r["round"] for r in rows})}
+    if rows:
+        last = rows[-1]
+        out.update(
+            n_alive=last["n_alive"], n_unconverged=last["n_unconverged"],
+            unconverged_frac=round(
+                last["n_unconverged"] / max(last["n_alive"], 1), 4),
+            wall_s=round(sum(r.get("round_seconds", 0) for r in rows
+                             if r.get("round_seconds")), 1),
+            closure_added_total=sum(r["n_closure_added"] for r in rows),
+            hub_overflow_last=last["n_hub_overflow"])
+    if mdirs:
+        scores = []
+        for f in sorted(glob.glob(os.path.join(mdirs[0], "*")),
+                        key=lambda p: int(os.path.basename(p)))[:20]:
+            pairs = np.loadtxt(f, dtype=np.int64)
+            lab = np.zeros(truth.shape[0], np.int64)
+            lab[pairs[:, 0] - 1] = pairs[:, 1]
+            scores.append(float(nmi(lab, truth)))
+        out["nmi_mean"] = round(float(np.mean(scores)), 4)
+        out["nmi_first"] = round(scores[0], 4)
+        out["n_scored"] = len(scores)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    for v in (sys.argv[1:] or ["b", "c", "a"]):
+        try:
+            score(v)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"variant": v, "error": str(e)}))

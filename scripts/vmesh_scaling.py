@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Relative multi-device scaling on the virtual CPU mesh (VERDICT r3 #6).
+
+Measures consensus wall time for one mid-size config across mesh shapes
+(p x e) on 8 virtual CPU devices (one physical socket).  ABSOLUTE rates
+are meaningless here — all 8 virtual devices share one core budget — but
+the SHAPE is informative: on one physical core, wall time approximates
+TOTAL work, so
+
+    overhead(shape) = wall(shape) / wall(1x1)
+
+is the collective + partitioning overhead sharding adds, and the ideal
+speedup on real chips is  (p*e) / overhead  (communication-free scaling
+would give overhead = 1.0 at every shape).
+
+Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/vmesh_scaling.py
+Writes BENCH_VMESH_SCALING.json at the repo root.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+
+from fastconsensus_tpu.utils.env import setup_compile_cache  # noqa: E402
+
+setup_compile_cache()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    from fastconsensus_tpu import parallel
+    from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
+    from fastconsensus_tpu.graph import pack_edges
+    from fastconsensus_tpu.models.registry import get_detector
+    from fastconsensus_tpu.utils.metrics import nmi
+    from fastconsensus_tpu.utils.synth import planted_partition
+
+    assert len(jax.devices()) == 8, jax.devices()
+    # mid-size skewed config: ~125k edges, the edge-scale regime the "e"
+    # axis exists for (same family as tests/test_parallel._big_skewed_graph)
+    edges, truth = planted_partition(20_000, 40, 0.025, 0.0002, seed=1)
+    slab = pack_edges(edges, 20_000)
+    det = get_detector("lpm")
+    # scatter engine everywhere so every shape runs the identical math
+    # (the mesh tails require it; ConsensusConfig.closure_sampler)
+    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.02,
+                          max_rounds=2, seed=3, closure_sampler="scatter")
+
+    shapes = [(1, 1), (8, 1), (4, 2), (2, 4), (1, 8)]
+    results = {}
+    base_wall = None
+    for p, e in shapes:
+        mesh = None
+        if (p, e) != (1, 1):
+            mesh = parallel.make_mesh(ensemble=p, edge=e,
+                                      devices=jax.devices()[:p * e])
+        t0 = time.perf_counter()
+        run_consensus(slab, det, cfg, key=jax.random.key(7), mesh=mesh)
+        compile_wall = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        r = run_consensus(slab, det, cfg, key=jax.random.key(8), mesh=mesh)
+        wall = time.perf_counter() - t0
+        if base_wall is None:
+            base_wall = wall
+        q = float(np.mean([nmi(part, truth) for part in r.partitions]))
+        results[f"{p}x{e}"] = {
+            "wall_s": round(wall, 2),
+            "overhead_vs_1x1": round(wall / base_wall, 3),
+            "ideal_speedup_real_chips": round(p * e / (wall / base_wall), 2),
+            "nmi": round(q, 4),
+            "rounds": r.rounds,
+            "compile_wall_s": round(compile_wall, 1),
+        }
+        print(f"{p}x{e}: wall {wall:.2f}s overhead "
+              f"{wall / base_wall:.3f} nmi {q:.4f}", flush=True)
+
+    out = {
+        "config": "planted 20k nodes / ~125k edges, lpm, n_p=8, 2 rounds "
+                  "+ final, scatter closure",
+        "note": "8 virtual CPU devices on one socket: wall ~ total work; "
+                "overhead_vs_1x1 is the sharding-added work, "
+                "ideal_speedup_real_chips = p*e/overhead",
+        "shapes": results,
+    }
+    with open(os.path.join(REPO, "BENCH_VMESH_SCALING.json"), "w") as fh:
+        json.dump(out, fh, indent=2)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
