@@ -44,9 +44,15 @@ CONFIGS = {
     # eval config 2 (the default driver config)
     "lfr1k": dict(kind="lfr", n=1000, mu=0.3, n_p=50, tau=0.2, delta=0.02,
                   alg="louvain"),
-    # eval config 3 analog (leiden on 10k)
+    # eval config 3 analog (leiden on 10k).  closure_tau = tau: with the
+    # round-4 threshold-at-insert densification control this config
+    # DELTA-CONVERGES (13 rounds, NMI 0.523 vs CPU 0.447 — the r4 A/B in
+    # runs/lfr10k_r4); without it, closure densifies faster than the
+    # theta-randomized ensemble can agree and only bounded-rounds
+    # operation is possible (BASELINE.md r3/r4).
     "lfr10k": dict(kind="lfr", n=10_000, mu=0.5, n_p=100, tau=0.2,
-                   delta=0.02, alg="leiden", max_rounds=12),
+                   delta=0.02, alg="leiden", max_rounds=16,
+                   closure_tau=0.2),
     # eval config 4 stand-in: SNAP email-Eu-core cannot be downloaded in
     # this environment (zero egress), so an SBM with its published shape
     # (1005 nodes, ~24k edges, 42 departments with heterogeneous sizes
@@ -209,7 +215,8 @@ def main() -> int:
     detector = get_detector(cfg["alg"])
     ccfg = ConsensusConfig(algorithm=cfg["alg"], n_p=cfg["n_p"],
                            tau=cfg["tau"], delta=cfg["delta"], seed=0,
-                           max_rounds=cfg.get("max_rounds", 64))
+                           max_rounds=cfg.get("max_rounds", 64),
+                           closure_tau=cfg.get("closure_tau"))
 
     on_round = None
     if os.environ.get("FCTPU_BENCH_VERBOSE"):

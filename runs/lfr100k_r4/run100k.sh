@@ -1,0 +1,19 @@
+#!/bin/bash
+# lfr100k round-4 A/B vs round-3 (VERDICT r3 #2): same config as the r3
+# run (louvain n_p=200 tau 0.2 delta 0.02 max-rounds 8, real-LFR 100k),
+# round-4 engine = CSR closure + budget regrowth.  Frozen worktree.
+set -u
+cd /tmp/fc_ab
+export PYTHONPATH=/tmp/fc_ab:/root/.axon_site
+d=/root/repo/runs/lfr100k_r4
+mkdir -p "$d"
+t0=$SECONDS
+python -m fastconsensus_tpu.utils.supervise --progress "$d/cache" \
+  --stall-seconds 600 -- \
+  python -m fastconsensus_tpu.cli -f "$d/graph.txt" --alg louvain -np 200 \
+    -t 0.2 -d 0.02 --seed 0 --max-rounds 8 \
+    --checkpoint "$d/ck.npz" --resume --detect-cache "$d/cache" \
+    --trace-jsonl "$d/rounds.jsonl" --out-dir "$d" \
+    >> "$d/run.log" 2>&1
+rc=$?
+echo "done rc=$rc wall=$((SECONDS-t0))s" >> "$d/run.log"
