@@ -20,7 +20,8 @@ run_variant () {
       --checkpoint "$d/ck.npz" --resume --detect-cache "$d/cache" \
       --trace-jsonl "$d/rounds.jsonl" --out-dir "$d" "$@" \
       >> "$d/run.log" 2>&1
-  echo "=== variant $name: done $(date +%T) rc=$? wall=$((SECONDS-t0))s" >> "$BASE/ab.log"
+  local rc=$?
+  echo "=== variant $name: done $(date +%T) rc=$rc wall=$((SECONDS-t0))s" >> "$BASE/ab.log"
 }
 
 run_variant b --closure-tau 0.2
