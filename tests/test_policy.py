@@ -1,0 +1,119 @@
+"""Unit tests for the single-source control policy (policy.py).
+
+The fused block evaluates these rules with jnp inside a while_loop and
+the host driver with numpy between device calls; the fused-vs-single
+parity tests in test_consensus.py check the integration, these check the
+rules themselves (including the division-free forms' agreement across
+array namespaces).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from fastconsensus_tpu import policy
+
+
+def _hist(entries):
+    return [{"n_unconverged": u, "n_alive": a, "cold": c}
+            for u, a, c in entries]
+
+
+def test_state_from_history_matches_incremental_observe():
+    hist = _hist([(90, 100, True), (70, 110, False), (70, 120, False),
+                  (65, 130, False), (80, 140, True), (60, 150, False)])
+    batch = policy.state_from_history(hist)
+    inc = policy.PolicyState(*(np.int32(v) for v in policy.INITIAL))
+    for h in hist:
+        inc = policy.observe(np, inc, np.bool_(h["cold"]),
+                             np.int32(h["n_unconverged"]),
+                             np.int32(h["n_alive"]))
+    for a, b in zip(batch, inc):
+        assert int(a) == int(b), (batch, inc)
+
+
+def test_observe_np_jnp_agree():
+    state_np = policy.PolicyState(*(np.int32(v) for v in policy.INITIAL))
+    state_j = policy.PolicyState(*(jnp.int32(v) for v in policy.INITIAL))
+    rounds = [(True, 90, 100), (False, 70, 110), (False, 71, 111),
+              (False, 72, 112), (False, 5, 120)]
+    for cold, u, a in rounds:
+        state_np = policy.observe(np, state_np, np.bool_(cold),
+                                  np.int32(u), np.int32(a))
+        state_j = policy.observe(jnp, state_j, jnp.bool_(cold),
+                                 jnp.int32(u), jnp.int32(a))
+        for x, y in zip(state_np, state_j):
+            assert int(x) == int(y)
+        for aligned in (False, True):
+            assert bool(policy.stalled(np, 0.02, state_np, aligned)) == \
+                bool(policy.stalled(jnp, 0.02, state_j,
+                                    jnp.bool_(aligned)))
+        assert bool(policy.stale(np, 0.02, state_np)) == \
+            bool(policy.stale(jnp, 0.02, state_j))
+        assert bool(policy.align_now(np, 0.5, state_np)) == \
+            bool(policy.align_now(jnp, 0.5, state_j))
+
+
+def test_stalled_requires_two_warm_rounds():
+    s = policy.PolicyState(*(np.int32(v) for v in policy.INITIAL))
+    assert not bool(policy.stalled(np, 0.0, s, False))
+    s = policy.observe(np, s, np.bool_(True), np.int32(500), np.int32(1000))
+    # one round only: u2 sentinel
+    assert not bool(policy.stalled(np, 0.0, s, False))
+    # second warm round with NO progress: stall fires
+    s = policy.observe(np, s, np.bool_(False), np.int32(500),
+                       np.int32(1000))
+    s = policy.observe(np, s, np.bool_(False), np.int32(500),
+                       np.int32(1000))
+    assert bool(policy.stalled(np, 0.0, s, False))
+    # a cold round resets the window
+    s = policy.observe(np, s, np.bool_(True), np.int32(500), np.int32(1000))
+    assert not bool(policy.stalled(np, 0.0, s, False))
+
+
+def test_stalled_aligned_threshold_gentler():
+    """7% relative progress: short of the 10% unaligned bar (stalls) but
+    enough under alignment's gentler 5% bar (no stall)."""
+    s = policy.PolicyState(*(np.int32(v) for v in policy.INITIAL))
+    s = policy.observe(np, s, np.bool_(True), np.int32(1000),
+                       np.int32(10000))
+    s = policy.observe(np, s, np.bool_(False), np.int32(1000),
+                       np.int32(10000))
+    s = policy.observe(np, s, np.bool_(False), np.int32(930),
+                       np.int32(10000))
+    assert bool(policy.stalled(np, 0.0, s, False))
+    assert not bool(policy.stalled(np, 0.0, s, True))
+
+
+def test_stall_floor_blocks_endgame_counts():
+    """Near the convergence bar, stagnation must not fire (a cold restart
+    would blow away nearly-converged state)."""
+    s = policy.PolicyState(*(np.int32(v) for v in policy.INITIAL))
+    s = policy.observe(np, s, np.bool_(True), np.int32(12), np.int32(1000))
+    s = policy.observe(np, s, np.bool_(False), np.int32(12), np.int32(1000))
+    s = policy.observe(np, s, np.bool_(False), np.int32(12), np.int32(1000))
+    assert not bool(policy.stalled(np, 0.02, s, False))  # 12 < floor 64
+
+
+def test_stale_fires_on_limit_cycle():
+    s = policy.PolicyState(*(np.int32(v) for v in policy.INITIAL))
+    s = policy.observe(np, s, np.bool_(True), np.int32(300), np.int32(1000))
+    # oscillation that never sets a new fraction minimum
+    for u in (340, 280, 310, 290, 320, 300):
+        s = policy.observe(np, s, np.bool_(False), np.int32(u),
+                           np.int32(1000))
+    # 280 set a minimum at step 2; the four rounds after it did not
+    assert int(s.scount) >= policy.STALE_ROUNDS
+    assert bool(policy.stale(np, 0.0, s))
+
+
+def test_budgets_stale_thresholds():
+    # hub: fires only past 1/8 of hub_cap, and only when hub path sized
+    assert not bool(policy.budgets_stale(np, 0, 100, 0, 800, 1000))
+    assert bool(policy.budgets_stale(np, 0, 101, 0, 800, 1000))
+    assert not bool(policy.budgets_stale(np, 0, 10_000, 0, 0, 1000))
+    # dense: budget is n_nodes * d_cap
+    assert not bool(policy.budgets_stale(np, 1000, 0, 8, 0, 1000))
+    assert bool(policy.budgets_stale(np, 1001, 0, 8, 0, 1000))
+    # jnp agreement
+    assert bool(policy.budgets_stale(jnp, 101, 0, 8, 800, 1000)) == \
+        bool(policy.budgets_stale(np, 101, 0, 8, 800, 1000))
