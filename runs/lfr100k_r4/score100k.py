@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Score the lfr100k round-4 run: NMI vs planted truth + the VERDICT r3
+#2 criterion (final-round hub overflow as a fraction of live hub mass)."""
+import glob, json, os, sys
+import numpy as np
+sys.path.insert(0, "/root/repo")
+BASE = os.path.dirname(os.path.abspath(__file__))
+
+def main():
+    from fastconsensus_tpu.utils.metrics import nmi
+    truth = np.load(os.path.join(BASE, "truth.npy"))
+    rows = [json.loads(ln) for ln in open(os.path.join(BASE, "rounds.jsonl"))
+            if ln.strip()]
+    out = {"rounds": rows[-1]["round"],
+           "wall_s": round(sum(r.get("round_seconds", 0) for r in rows), 1),
+           "hub_overflow_by_round": [r["n_hub_overflow"] for r in rows],
+           "unconverged_frac_by_round": [
+               round(r["n_unconverged"] / max(r["n_alive"], 1), 3)
+               for r in rows]}
+    # hub mass fraction criterion from the final checkpoint
+    try:
+        from fastconsensus_tpu.utils import checkpoint as ckpt
+        slab, *_ = ckpt.load_checkpoint(os.path.join(BASE, "ck.npz"))
+        import jax
+        deg = np.asarray(jax.device_get(slab.degrees()))
+        hub_mass = int(deg[deg > slab.d_hyb].sum())
+        out["d_hyb"] = slab.d_hyb
+        out["hub_cap"] = slab.hub_cap
+        out["hub_mass"] = hub_mass
+        out["final_hub_overflow_frac_of_mass"] = round(
+            rows[-1]["n_hub_overflow"] / max(hub_mass, 1), 4)
+    except Exception as e:  # noqa: BLE001
+        out["ck_error"] = str(e)
+    mdirs = glob.glob(os.path.join(BASE, "memberships_*"))
+    if mdirs:
+        scores = []
+        for f in sorted(glob.glob(os.path.join(mdirs[0], "*")),
+                        key=lambda p: int(os.path.basename(p)))[:20]:
+            pairs = np.loadtxt(f, dtype=np.int64)
+            lab = np.zeros(truth.shape[0], np.int64)
+            lab[pairs[:, 0] - 1] = pairs[:, 1]
+            scores.append(float(nmi(lab, truth)))
+        out["nmi_mean20"] = round(float(np.mean(scores)), 4)
+        out["nmi_first"] = round(scores[0], 4)
+    print(json.dumps(out))
+
+if __name__ == "__main__":
+    main()
