@@ -182,12 +182,13 @@ def _resume_from_checkpoint(checkpoint_path: str, slab: GraphSlab,
     saved = {k: extra.get(k) for k in
              ("algorithm", "n_p", "tau", "delta", "gamma",
               "warm_start", "align_frac", "closure_sampler")}
-    # closure_tau's legitimate default IS None, so absence must be
-    # distinguished from a saved None by key presence (a pre-knob
-    # checkpoint tolerates any requested value the other keys would;
-    # a checkpoint that SAVED no-bar must reject a resumed bar).
-    if "closure_tau" in extra and extra["closure_tau"] != \
-            config.closure_tau:
+    # Pre-r4 checkpoints predate the closure_tau knob, but the historical
+    # value is known: every such run inserted with no bar, so backfill
+    # None (mirrors the closure_sampler migration above) and reject a
+    # resumed bar — mixing unbarred and barred insert semantics in one
+    # run is exactly what this check exists to prevent (ADVICE round 4).
+    extra.setdefault("closure_tau", None)
+    if extra["closure_tau"] != config.closure_tau:
         raise ValueError(
             f"checkpoint {checkpoint_path} was written with closure_tau="
             f"{extra['closure_tau']}; resuming with "

@@ -229,9 +229,21 @@ def partner_draw_batches(key, srcd, dstd, valid_e, n: int, capacity: int,
     # with a PADDING draw's key (indices >= draws); those draws' outputs
     # are never inside the unpadded window, so the collision is inert.
     total = draws * n
+    if total >= 2 ** 31:
+        # jax.random.randint(high=total) and the int32 window arithmetic
+        # below both break past 2^31 entries; fail loudly instead of
+        # wrapping to negative indices (ADVICE round 4).
+        raise ValueError(
+            f"wedge grid draws*n = {total} exceeds int32 indexing; "
+            "shard the closure-candidate axis before scaling here")
     off = jax.random.randint(
         jax.random.fold_in(key, draws), (), 0, total, dtype=jnp.int32)
-    idx = (jnp.arange(n_samples, dtype=jnp.int32) + off) % jnp.int32(total)
+    # where-based wrap instead of (arange + off) % total: the raw sum can
+    # reach 2*total and would wrap int32 before the modulus once
+    # total > 2^30; each selected lane below stays < total.
+    ar = jnp.arange(n_samples, dtype=jnp.int32)
+    rem = jnp.int32(total) - off
+    idx = jnp.where(ar < rem, ar + off, ar - rem)
     return us.reshape(-1)[idx], vs.reshape(-1)[idx], oks.reshape(-1)[idx]
 
 

@@ -35,6 +35,7 @@ def setup_compile_cache() -> None:
     """
     import hashlib
     import os
+    import sys
 
     try:
         with open("/proc/cpuinfo") as fh:
@@ -42,7 +43,25 @@ def setup_compile_cache() -> None:
         tag = hashlib.sha1(flags.encode()).hexdigest()[:8]
     except (OSError, StopIteration):
         tag = "generic"
-    os.environ.setdefault(
+    cache_dir = os.environ.get(
         "JAX_COMPILATION_CACHE_DIR",
         os.path.expanduser(f"~/.cache/fctpu_xla_{tag}"))
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    raw_secs = os.environ.get(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+    try:
+        min_secs = float(raw_secs)
+    except ValueError:
+        raise ValueError(
+            "environment variable JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_"
+            f"SECS={raw_secs!r} is not a number") from None
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = raw_secs
+    if "jax" in sys.modules:
+        # jax reads these env vars at import time; importing anything from
+        # this package pulls jax in first, so set the live config too
+        # (ADVICE round 4: os.environ alone is a silent no-op here).
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_secs)
