@@ -430,7 +430,8 @@ def run_consensus(slab: GraphSlab,
             fp_base = hashlib.sha1(repr(
                 (config.algorithm, config.n_p, config.tau, config.delta,
                  config.seed, config.max_rounds, slab.n_nodes,
-                 slab.cap_hint or slab.capacity, config.gamma, warm,
+                 slab.cap_hint or slab.capacity, slab.agg_cap,
+                 config.gamma, warm,
                  config.align_frac, sampler, config.closure_tau,
                  tuple(mesh.shape.items()) if mesh is not None else None)
             ).encode()).hexdigest()[:10]
@@ -580,34 +581,52 @@ def run_consensus(slab: GraphSlab,
         h = history[-1]
         if budget_noop is not None and \
                 h["n_overflow"] <= budget_noop[0] and \
-                h["n_hub_overflow"] <= budget_noop[1]:
+                h["n_hub_overflow"] <= budget_noop[1] and \
+                h["n_alive"] <= budget_noop[2]:
             return
         if not bool(policy.budgets_stale(
                 np, h["n_overflow"], h["n_hub_overflow"], slab.d_cap,
-                slab.hub_cap, slab.n_nodes)):
+                slab.hub_cap, slab.n_nodes, h["n_alive"], slab.agg_cap)):
             return
-        from fastconsensus_tpu.graph import (derive_dense_sizing,
+        from fastconsensus_tpu.graph import (derive_agg_sizing,
+                                             derive_dense_sizing,
                                              derive_hybrid_sizing)
 
         deg = np.asarray(jax.device_get(slab.degrees())).astype(np.int64)
         n_alive = int(np.asarray(jax.device_get(slab.num_alive())))
         new_d_cap = derive_dense_sizing(deg, slab.n_nodes)
         new_hyb, new_hub = derive_hybrid_sizing(deg, slab.n_nodes, n_alive)
-        if (new_d_cap, new_hyb, new_hub) == \
-                (slab.d_cap, slab.d_hyb, slab.hub_cap):
+        # agg_cap == 0 means compaction is off for this run (a resumed
+        # pre-r5 checkpoint): never turn it on mid-run — that would change
+        # the aggregate-move lowering the run was started with.
+        new_agg = derive_agg_sizing(n_alive) if slab.agg_cap > 0 \
+            else 0
+        if (new_d_cap, new_hyb, new_hub, new_agg) == \
+                (slab.d_cap, slab.d_hyb, slab.hub_cap, slab.agg_cap):
             # re-derivation cannot help at these overflow levels; suppress
-            # until starvation worsens (and let fused blocks run full)
-            budget_noop = (h["n_overflow"], h["n_hub_overflow"])
+            # until starvation worsens (and let fused blocks run full).
+            # The alive entry is the level at which the AGG STALE TERM
+            # would newly fire (policy.budgets_stale: 25% past agg_cap)
+            # — NOT the observed alive count: closure grows n_alive a
+            # little every round, and piercing on raw growth would
+            # re-break fused blocks and re-read the degree histogram
+            # every round while dense/hub staleness persists unchanged
+            # (round-5 review).
+            budget_noop = (h["n_overflow"], h["n_hub_overflow"],
+                           (5 * slab.agg_cap) // 4 if slab.agg_cap > 0
+                           else 2 ** 31 - 1)
             return
         budget_noop = None
         _logger.warning(
-            "move-candidate budgets starved (overflow %d dense / %d hub): "
-            "re-deriving from the live degree histogram: d_cap %d -> %d, "
-            "d_hyb %d -> %d, hub_cap %d -> %d (one recompile)",
-            h["n_overflow"], h["n_hub_overflow"], slab.d_cap, new_d_cap,
-            slab.d_hyb, new_hyb, slab.hub_cap, new_hub)
+            "move-candidate budgets starved (overflow %d dense / %d hub, "
+            "%d alive): re-deriving from the live degree histogram: "
+            "d_cap %d -> %d, d_hyb %d -> %d, hub_cap %d -> %d, "
+            "agg_cap %d -> %d (one recompile)",
+            h["n_overflow"], h["n_hub_overflow"], h["n_alive"],
+            slab.d_cap, new_d_cap, slab.d_hyb, new_hyb, slab.hub_cap,
+            new_hub, slab.agg_cap, new_agg)
         slab = dataclasses.replace(slab, d_cap=new_d_cap, d_hyb=new_hyb,
-                                   hub_cap=new_hub)
+                                   hub_cap=new_hub, agg_cap=new_agg)
         setup_executables()
 
     def grow_and_replay(pre_slab: GraphSlab, dropped: int) -> None:
@@ -669,7 +688,7 @@ def run_consensus(slab: GraphSlab,
     # that produced UNCHANGED sizing (None = none).  Until the overflow
     # worsens past these levels, re-checking cannot help and would only
     # stop fused blocks + re-read the degree histogram every round.
-    budget_noop: Optional[Tuple[int, int]] = None
+    budget_noop: Optional[Tuple[int, int, int]] = None
     converged = resumed_converged
     rounds = start_round
     end_round = start_round if resumed_converged else config.max_rounds
@@ -697,7 +716,8 @@ def run_consensus(slab: GraphSlab,
             labels0 = cur_labels if warm else jnp.zeros(
                 (config.n_p, slab.n_nodes), jnp.int32)
             t0 = time.perf_counter()
-            noop = budget_noop if budget_noop is not None else (-1, -1)
+            noop = budget_noop if budget_noop is not None \
+                else (-1, -1, -1)
             slab, done, buf, new_labels = block_fn(
                 slab, key, labels0, jnp.int32(r), jnp.int32(end_round - r),
                 jnp.bool_(align_now(r)),

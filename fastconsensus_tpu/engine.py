@@ -401,9 +401,11 @@ def consensus_rounds_block(slab: GraphSlab,
             aligned = jnp.bool_(False)
         need = policy.budgets_stale(jnp, st.n_overflow, st.n_hub_overflow,
                                     slab.d_cap, slab.hub_cap,
-                                    slab.n_nodes) & \
+                                    slab.n_nodes, st.n_alive,
+                                    slab.agg_cap) & \
             jnp.asarray(watch0) & \
-            ((st.n_overflow > noop0[0]) | (st.n_hub_overflow > noop0[1]))
+            ((st.n_overflow > noop0[0]) | (st.n_hub_overflow > noop0[1]) |
+             (st.n_alive > noop0[2]))
         return (slab, i + 1, st.converged, buf, labels, aligned, pst, need)
 
     pst0 = policy.PolicyState(*(jnp.asarray(v, jnp.int32)

@@ -71,6 +71,16 @@ class GraphSlab:
     # unavailable (aggregated supernode graphs, hand-built slabs).
     d_hyb: int = dataclasses.field(default=0, metadata=dict(static=True))
     hub_cap: int = dataclasses.field(default=0, metadata=dict(static=True))
+    # Static capacity for the compacted aggregate-level slab
+    # (models/leiden.py): the aggregate move otherwise runs the hash path
+    # over every slot of THIS slab while only the alive fraction holds
+    # aggregate edges — measured 18.3 -> 9.5 ms/member/sweep at half
+    # capacity on lfr10k (runs/kernel_profile/profile.json, round 5).
+    # Distinct aggregate pairs never exceed the alive edge count, so
+    # agg_cap >= n_alive guarantees a lossless compaction; the driver
+    # re-derives it from the live alive count alongside the other budgets
+    # (derive_agg_sizing).  0 = compaction off (pre-r5 semantics).
+    agg_cap: int = dataclasses.field(default=0, metadata=dict(static=True))
 
     @property
     def capacity(self) -> int:
@@ -162,6 +172,64 @@ def derive_hybrid_sizing(degree: np.ndarray, n_nodes: int,
     return d_hyb, hub_cap
 
 
+def derive_agg_sizing(n_alive: int) -> int:
+    """Compacted-aggregate capacity from the live alive-edge count.
+
+    ``n_alive`` bounds the distinct aggregate pairs (each alive edge maps
+    to exactly one community pair), so this is lossless until closure
+    densifies the slab past the slack; 12.5% + one lane-multiple covers
+    ~1-2 rounds of measured closure growth (lfr10k: ~25k inserts/round on
+    ~60-300k alive), and the driver refreshes it together with every
+    d_cap/d_hyb/hub_cap re-derivation so agg growth rarely costs its own
+    recompile.  Slack is deliberately tight: the per-sweep hash cost is
+    linear in this capacity (the round-5 kernel profile), while a regrow
+    is one (batched) recompile.
+    """
+    if n_alive <= 0:
+        return 0
+    want = n_alive + n_alive // 8 + 1024
+    return ((want + 4095) // 4096) * 4096
+
+
+def compact_alive(slab: GraphSlab, cap: int) -> GraphSlab:
+    """Pack the alive edges into a fresh slab of static capacity ``cap``.
+
+    Traced (jit/vmap-safe): one cumsum + four scatters over the source
+    capacity, amortized across every subsequent per-sweep scan of the
+    compact slab.  Alive slot order is preserved.  Alive edges ranked
+    beyond ``cap`` are DROPPED — callers size ``cap`` with
+    :func:`derive_agg_sizing` (>= the alive count at derivation time),
+    and drops only ever affect move *candidates* of the aggregate level
+    (the consensus slab itself is untouched).  Once closure grows the
+    alive count past the slack, mild drops can PERSIST for several
+    rounds: the driver refreshes agg_cap for free whenever any dense/hub
+    budget regrows, but the standalone agg trigger is deliberately loose
+    (25% past budget — policy.budgets_stale) so agg staleness alone
+    rarely costs a recompile.
+
+    The result carries no dense/hybrid sizing (aggregate supernode degrees
+    are unbounded) and ``cap_hint = cap`` so hash-bucket sizing tracks the
+    compact shape.
+    """
+    pos = jnp.cumsum(slab.alive.astype(jnp.int32)) - 1
+    ok = slab.alive & (pos < cap)
+    tgt = jnp.where(ok, pos, cap)
+
+    def scat(x, dtype):
+        buf = jnp.zeros((cap + 1,), dtype)
+        # not-ok lanes all write 0 to the spill slot `cap`, sliced off
+        return buf.at[tgt].set(jnp.where(ok, x, 0))[:cap]
+
+    n_keep = jnp.minimum(slab.num_alive(), cap)
+    return GraphSlab(
+        src=scat(slab.src, jnp.int32),
+        dst=scat(slab.dst, jnp.int32),
+        weight=scat(slab.weight, jnp.float32),
+        alive=jnp.arange(cap, dtype=jnp.int32) < n_keep,
+        n_nodes=slab.n_nodes, d_cap=0, cap_hint=cap,
+        d_hyb=0, hub_cap=0, agg_cap=0)
+
+
 def pack_edges(edges: np.ndarray,
                n_nodes: int,
                weights: Optional[np.ndarray] = None,
@@ -215,7 +283,8 @@ def pack_edges(edges: np.ndarray,
                      weight=jnp.asarray(w), alive=jnp.asarray(alive),
                      n_nodes=int(n_nodes), d_cap=d_cap,
                      cap_hint=2 * n_edges + 16,
-                     d_hyb=d_hyb, hub_cap=hub_cap)
+                     d_hyb=d_hyb, hub_cap=hub_cap,
+                     agg_cap=derive_agg_sizing(n_edges))
 
 
 def grow_slab(slab: GraphSlab, new_capacity: int) -> GraphSlab:

@@ -84,6 +84,17 @@ def leiden_single(slab: GraphSlab, key: jax.Array,
     # The aggregate starts from an already-converged assignment, so it too
     # needs only the half budget.
     agg = aggregate(slab, refined)
+    if 0 < slab.agg_cap < slab.capacity:
+        # Compacted aggregate move: the hash path's per-sweep cost is
+        # linear in the scanned capacity, and the aggregate uses only
+        # ~the alive fraction of the consensus slab's slots (27.4 ->
+        # ~11 ms/member/sweep measured, runs/kernel_profile/profile.json).
+        # agg_cap >= the alive count at sizing time makes this lossless
+        # (distinct aggregate pairs <= alive edges); the driver re-derives
+        # agg_cap with the other budgets as closure densifies the slab.
+        from fastconsensus_tpu.graph import compact_alive
+
+        agg = compact_alive(agg, slab.agg_cap)
     group_comm = jax.ops.segment_max(
         comm, jnp.clip(refined, 0, n - 1), num_segments=n)
     lvl = local_move(agg, k2, init_labels=group_comm.astype(jnp.int32),

@@ -180,7 +180,8 @@ def align_now(xp, align_frac: float, state: PolicyState):
 
 
 def budgets_stale(xp, n_overflow, n_hub_overflow, d_cap: int,
-                  hub_cap: int, n_nodes: int):
+                  hub_cap: int, n_nodes: int,
+                  n_alive=0, agg_cap: int = 0):
     """Are the static move-candidate budgets starving under densification?
 
     The dense/hybrid detection paths drop move candidates beyond their
@@ -203,7 +204,15 @@ def budgets_stale(xp, n_overflow, n_hub_overflow, d_cap: int,
         else xp.asarray(False)
     dense = (xp.asarray(n_overflow) * 8 > n_nodes * d_cap) if d_cap > 0 \
         else xp.asarray(False)
-    return hub | dense
+    # Compacted-aggregate starvation (graph.derive_agg_sizing): distinct
+    # aggregate pairs <= n_alive, so a loss is only *possible* past
+    # agg_cap.  The standalone threshold is deliberately loose (25% past
+    # the budget — by then the compaction win is gone anyway): every
+    # dense/hub firing re-derives agg_cap for free, so mild agg staleness
+    # between firings never costs a recompile of its own.
+    agg = (xp.asarray(n_alive) * 4 > agg_cap * 5) if agg_cap > 0 \
+        else xp.asarray(False)
+    return hub | dense | agg
 
 
 def state_from_history(history: List[dict]) -> PolicyState:

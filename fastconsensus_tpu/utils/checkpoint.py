@@ -53,6 +53,7 @@ def save_checkpoint(path: str,
         "cap_hint": int(slab.cap_hint),
         "d_hyb": int(slab.d_hyb),
         "hub_cap": int(slab.hub_cap),
+        "agg_cap": int(slab.agg_cap),
         "rounds_done": int(rounds_done),
         "history": history,
         "extra": extra or {},
@@ -98,7 +99,11 @@ def load_checkpoint(path: str
                          d_cap=int(meta.get("d_cap", 0)),
                          cap_hint=int(meta.get("cap_hint", 0)),
                          d_hyb=int(meta.get("d_hyb", 0)),
-                         hub_cap=int(meta.get("hub_cap", 0)))
+                         hub_cap=int(meta.get("hub_cap", 0)),
+                         # absent in pre-r5 checkpoints: 0 keeps the
+                         # aggregate move uncompacted, i.e. the exact
+                         # lowering the run was started with
+                         agg_cap=int(meta.get("agg_cap", 0)))
         extra = dict(meta["extra"])
         if meta.get("version") == 1:
             extra["_legacy_v1"] = True

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Root-cause the n_want sweep plateau (VERDICT r4 #1b).
+
+Round-4 measured: on lfr10k/mu0.5 the cold main-move loop never
+converges — n_want plateaus at ~10% of nodes under every masking
+variant, so detection always burns its full 32-sweep budget, and MORE
+sweeps make single-run quality WORSE (NMI 0.50 at 8 sweeps vs 0.42 at
+32).  Two hypotheses:
+
+  (A) synchronous churn: simultaneously-applied positive-gain moves
+      jointly DECREASE modularity (the classic synchronous-update
+      pathology, possible at distance 2 through shared communities even
+      with adjacent-swap breaking) — then per-sweep Q should fall or
+      oscillate after an early peak, and a best-Q label snapshot would
+      recover the peak for free;
+  (B) modularity keeps improving but away from the planted structure
+      (degenerate-landscape overfit) — then Q rises monotonically while
+      NMI falls, early stopping trades Q for NMI, and the fix is a
+      sweep-budget policy, not a snapshot.
+
+This script measures per-sweep Q, n_want, n_moved and NMI-vs-truth
+every 4 sweeps for 48 sweeps of the cold main move on the real lfr10k
+graph (batch of 8 members), and prints the trajectory.  Artifact:
+runs/kernel_profile/sweep_diag.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from fastconsensus_tpu.utils.env import setup_compile_cache  # noqa: E402
+
+setup_compile_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+SWEEPS = 48
+SNAP_EVERY = 4
+BATCH = 8
+
+
+def modularity(slab, labels, m2):
+    n = slab.n_nodes
+    srcd, dstd, wd, ad = slab.directed()
+    lab_s = labels[jnp.clip(srcd, 0, n - 1)]
+    lab_d = labels[jnp.clip(dstd, 0, n - 1)]
+    intra = jnp.sum(jnp.where(ad & (lab_s == lab_d), wd, 0.0))
+    strength = slab.strengths()
+    sigma = jax.ops.segment_sum(strength, jnp.clip(labels, 0, n - 1),
+                                num_segments=n)
+    return intra / m2 - jnp.sum((sigma / m2) ** 2)
+
+
+def main():
+    from fastconsensus_tpu.graph import pack_edges
+    from fastconsensus_tpu.models import louvain as lv
+    from fastconsensus_tpu.ops import dense_adj as da
+    from fastconsensus_tpu.ops import segment as seg
+
+    edges = np.loadtxt(os.path.join(REPO, "runs", "lfr10k_r4", "graph.txt"),
+                       dtype=np.int64)
+    truth = np.load(os.path.join(REPO, "runs", "lfr10k_r4", "truth.npy"))
+    n = int(edges.max()) + 1
+    slab = pack_edges(edges, n_nodes=n)
+    assert lv.select_move_path(slab) == "hybrid"
+
+    n_snaps = SWEEPS // SNAP_EVERY
+
+    def run(key):
+        labels = jnp.arange(n, dtype=jnp.int32)
+        srcd, _, wd, ad = slab.directed()
+        m2 = jnp.maximum(jnp.sum(jnp.where(ad, wd, 0.0)), 1e-9)
+        strength = slab.strengths()
+        hyb = da.build_hybrid(slab)
+        n_buckets = seg.hash_buckets_for(slab.hub_cap + n)
+
+        def body(it, carry):
+            labels, qs, wants, moved, snaps = carry
+            k_step, k_pri, k_mask = jax.random.split(
+                jax.random.fold_in(key, it), 3)
+            best, want = lv._move_step_hybrid(
+                hyb, slab, labels, k_step, m2, strength, n_buckets, 1.0,
+                0.0)
+            n_want = jnp.sum(want.astype(jnp.int32))
+            # same adaptive masking as local_move
+            endgame = n_want <= jnp.int32(max(1, int(0.05 * n)))
+            bern = jax.random.bernoulli(k_mask, 0.5, (n,))
+            swap = lv._swap_break(k_pri, slab, want, None, hyb)
+            mask = jnp.where(endgame, swap, bern)
+            new_labels = jnp.where(want & mask, best, labels)
+            q = modularity(slab, new_labels, m2)
+            qs = qs.at[it].set(q)
+            wants = wants.at[it].set(n_want)
+            moved = moved.at[it].set(
+                jnp.sum((new_labels != labels).astype(jnp.int32)))
+            snaps = jax.lax.cond(
+                (it + 1) % SNAP_EVERY == 0,
+                lambda s: s.at[(it + 1) // SNAP_EVERY - 1].set(new_labels),
+                lambda s: s, snaps)
+            return new_labels, qs, wants, moved, snaps
+
+        return jax.lax.fori_loop(
+            0, SWEEPS, body,
+            (labels, jnp.zeros((SWEEPS,), jnp.float32),
+             jnp.zeros((SWEEPS,), jnp.int32), jnp.zeros((SWEEPS,), jnp.int32),
+             jnp.zeros((n_snaps, n), jnp.int32)))
+
+    keys = jax.random.split(jax.random.PRNGKey(0), BATCH)
+    t0 = time.perf_counter()
+    labels, qs, wants, moved, snaps = jax.jit(jax.vmap(run))(keys)
+    qs = jax.device_get(qs)
+    wants = jax.device_get(wants)
+    moved = jax.device_get(moved)
+    snaps = jax.device_get(snaps)
+    print(f"ran {SWEEPS} instrumented sweeps x {BATCH} members in "
+          f"{time.perf_counter() - t0:.1f}s", flush=True)
+
+    from fastconsensus_tpu.utils.metrics import nmi
+
+    art = {"sweeps": SWEEPS, "batch": BATCH, "per_sweep": []}
+    print("sweep |   mean Q   | mean n_want | mean moved")
+    for t in range(SWEEPS):
+        row = {"sweep": t + 1, "q_mean": float(qs[:, t].mean()),
+               "q_min": float(qs[:, t].min()),
+               "q_max": float(qs[:, t].max()),
+               "n_want_mean": float(wants[:, t].mean()),
+               "n_moved_mean": float(moved[:, t].mean())}
+        art["per_sweep"].append(row)
+        if (t + 1) % 2 == 0 or t < 8:
+            print(f"  {t + 1:3d} | {row['q_mean']:.5f} "
+                  f"| {row['n_want_mean']:10.0f} | {row['n_moved_mean']:9.0f}",
+                  flush=True)
+    print("snapshot NMI vs planted truth (mean over members):")
+    art["nmi"] = []
+    for si in range(snaps.shape[1]):
+        vals = [float(nmi(np.asarray(snaps[b, si]), truth))
+                for b in range(BATCH)]
+        sweep = (si + 1) * SNAP_EVERY
+        art["nmi"].append({"sweep": sweep,
+                           "nmi_mean": float(np.mean(vals)),
+                           "nmi_min": float(np.min(vals)),
+                           "nmi_max": float(np.max(vals))})
+        print(f"  sweep {sweep:3d}: NMI {np.mean(vals):.4f} "
+              f"[{np.min(vals):.4f}, {np.max(vals):.4f}]", flush=True)
+    outdir = os.path.join(REPO, "runs", "kernel_profile")
+    os.makedirs(outdir, exist_ok=True)
+    with open(os.path.join(outdir, "sweep_diag.json"), "w") as fh:
+        json.dump(art, fh, indent=1)
+    print(f"wrote {outdir}/sweep_diag.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -78,3 +78,51 @@ def test_partition_writers_roundtrip(tmp_path):
     # memberships use 1-indexed compact ids regardless of original ids
     lines = open(os.path.join(mem, "0")).read().splitlines()
     assert lines[0] == "1\t1" and lines[2] == "3\t2"
+
+
+def test_compact_alive_preserves_edges():
+    from fastconsensus_tpu.graph import compact_alive
+    import jax.numpy as jnp
+
+    edges = np.array([[0, 1], [1, 2], [2, 3], [3, 4], [0, 4]])
+    slab = pack_edges(edges, n_nodes=5)  # capacity 2*5+16 = 26
+    # kill one edge to make the alive set non-prefix
+    alive = np.asarray(slab.alive).copy()
+    alive[1] = False
+    import dataclasses
+    slab = dataclasses.replace(slab, alive=jnp.asarray(alive))
+    c = compact_alive(slab, 8)
+    assert c.capacity == 8
+    assert int(c.num_alive()) == 4
+    got = sorted(zip(np.asarray(c.src)[:4].tolist(),
+                     np.asarray(c.dst)[:4].tolist()))
+    u, v, w = host_edges(slab)
+    assert got == sorted(zip(u.tolist(), v.tolist()))
+    # compact slab carries no dense/hybrid sizing; cap_hint tracks cap
+    assert (c.d_cap, c.d_hyb, c.hub_cap, c.agg_cap) == (0, 0, 0, 0)
+    assert c.cap_hint == 8
+    # weights survive, dead tail is inert
+    assert np.asarray(c.weight)[:4].sum() == w.sum()
+    assert not np.asarray(c.alive)[4:].any()
+
+
+def test_compact_alive_overflow_drops_tail_ranks():
+    from fastconsensus_tpu.graph import compact_alive
+
+    edges = np.array([[i, i + 1] for i in range(10)])
+    slab = pack_edges(edges, n_nodes=11)
+    c = compact_alive(slab, 6)
+    assert int(c.num_alive()) == 6
+    # first six alive ranks kept, in slot order
+    assert np.asarray(c.src)[:6].tolist() == list(range(6))
+
+
+def test_derive_agg_sizing_bounds():
+    from fastconsensus_tpu.graph import derive_agg_sizing
+
+    assert derive_agg_sizing(0) == 0
+    for e in (100, 58_712, 313_765):
+        cap = derive_agg_sizing(e)
+        assert cap >= e            # lossless at derivation time
+        assert cap % 4096 == 0
+        assert cap <= e + e // 8 + 1024 + 4096  # tight slack
