@@ -431,6 +431,14 @@ def run_consensus(slab: GraphSlab,
                 (config.algorithm, config.n_p, config.tau, config.delta,
                  config.seed, config.max_rounds, slab.n_nodes,
                  slab.cap_hint or slab.capacity, slab.agg_cap,
+                 # the candidate budgets select the move lowering, and
+                 # labels depend on the lowering.  In-run they are a pure
+                 # function of (history, graph) so a killed-and-restarted
+                 # process re-derives them identically — but a CODE change
+                 # to the derivation between attempts (the live-tree
+                 # import hazard, BASELINE.md) must orphan the chunks,
+                 # not silently mix lowerings within one round.
+                 slab.d_cap, slab.d_hyb, slab.hub_cap,
                  config.gamma, warm,
                  config.align_frac, sampler, config.closure_tau,
                  tuple(mesh.shape.items()) if mesh is not None else None)

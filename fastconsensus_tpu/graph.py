@@ -151,24 +151,54 @@ def derive_hybrid_sizing(degree: np.ndarray, n_nodes: int,
                          n_edges: int) -> Tuple[int, int]:
     """Hybrid-path sizing (d_hyb, hub_cap) from a degree histogram.
 
-    Rows wide enough for ~p95 of degrees (so the padded area stays small
-    on skewed distributions), hubs above it served by hashed aggregation
-    over a compacted edge prefix with 1.5x growth slack
-    (ops/dense_adj.py:build_hybrid).  Degenerate when p95 ~ max (uniform
-    degrees: the plain dense path already fits).  Shared by pack_edges
-    and the driver's mid-run budget re-derivation — the sizing must be a
-    pure function of the degree histogram so replays and resumes
-    reproduce it (same contract as cap_hint).
+    The partition point is chosen by a per-sweep COST MODEL, not a degree
+    quantile: every row slot costs ~3 random-access ops per sweep (the
+    labels/sigma/rep gathers — and random access is the hot sweep's
+    binding resource, at ~100% of the measured scatter ceiling:
+    BASELINE.md round-5 kernel profile), and every hub directed edge
+    costs ~6 (two-table hash build + lookup + argmax scatters, over a
+    1.5x-slack prefix).  Minimizing
+
+        cost(d) = 3 * N * (d + 1) + 6 * hub_mass(d)
+
+    over lane-multiples of 8 replaces round 2's p95-quantile rule, which
+    ignored the row side entirely: on the densified lfr100k slab (mean
+    degree ~46 after closure) p95 drove d_hyb to 168 — 50M row-gather
+    ops/sweep — where the cost optimum serves the same graph several
+    times cheaper by widening the hub prefix instead.  Hubs above the cut
+    get hashed aggregation (ops/dense_adj.py:build_hybrid); 1.5x growth
+    slack on the prefix as before.  Degenerate (0, 0) when no cut beats
+    the pure-hash cost baseline (~8 random ops per directed edge slot) —
+    near-uniform degree distributions, where the dense or hash paths
+    already serve every node.  Shared by pack_edges and the driver's
+    mid-run budget re-derivation — the sizing must be a pure function of
+    the degree histogram so replays and resumes reproduce it (same
+    contract as cap_hint).
     """
     if n_nodes <= 0 or n_edges <= 0:
         return 0, 0
-    p95 = int(np.quantile(degree, 0.95, method="higher"))
-    d_hyb = min((5 * p95) // 4 + 8, max(n_nodes - 1, 1))
-    d_hyb = int(((d_hyb + 7) // 8) * 8)
-    hub_mass = int(degree[degree > d_hyb].sum())
-    hub_cap = int((((3 * hub_mass) // 2 + 64 + 7) // 8) * 8)
-    if d_hyb > DENSE_D_MAX:
+    max_deg = int(degree.max(initial=0))
+    hi = min(max(((max_deg + 7) // 8) * 8, 8), DENSE_D_MAX,
+             max(n_nodes - 1, 1))
+    cands = np.arange(8, hi + 1, 8, dtype=np.int64)
+    if cands.size == 0:
         return 0, 0
+    # hub_mass(d) = sum of degrees strictly above d, for every candidate
+    # at once: sorted degrees + prefix sums + one searchsorted
+    srt = np.sort(degree.astype(np.int64))
+    csum = np.concatenate([[0], np.cumsum(srt)])
+    total = int(csum[-1])
+    idx = np.searchsorted(srt, cands, side="right")
+    hub_mass = total - csum[idx]
+    cost = 3 * n_nodes * (cands + 1) + 6 * hub_mass
+    best = int(np.argmin(cost))
+    # pure-hash baseline (~8 random ops per directed edge slot, round-5
+    # kernel accounting): when no cut beats it the hybrid layout only
+    # adds work — return degenerate and let select_move_path fall through
+    if int(cost[best]) >= 8 * 2 * n_edges:
+        return 0, 0
+    d_hyb = int(cands[best])
+    hub_cap = int((((3 * int(hub_mass[best])) // 2 + 64 + 7) // 8) * 8)
     return d_hyb, hub_cap
 
 

@@ -84,7 +84,12 @@ def leiden_single(slab: GraphSlab, key: jax.Array,
     # The aggregate starts from an already-converged assignment, so it too
     # needs only the half budget.
     agg = aggregate(slab, refined)
-    if 0 < slab.agg_cap < slab.capacity:
+    # Growth-stability: gate on the pack-time capacity hint, not live
+    # capacity — labels must not change when auto-growth (or a generous
+    # --capacity) resizes the slab mid-run (the louvain._cap_hint
+    # contract; round-5 review).  Late-run agg_cap may exceed live
+    # capacity by its 12.5% slack — a bounded waste, never a loss.
+    if 0 < slab.agg_cap < (slab.cap_hint or slab.capacity):
         # Compacted aggregate move: the hash path's per-sweep cost is
         # linear in the scanned capacity, and the aggregate uses only
         # ~the alive fraction of the consensus slab's slots (27.4 ->

@@ -92,12 +92,29 @@ def members_per_call(slab: GraphSlab, n_p: int,
     anything has been measured in this process, the
     :func:`est_member_seconds` prior.  FCTPU_DETECT_CALL_MEMBERS overrides
     everything (<= 0 disables splitting).
+
+    The raw count is snapped DOWN to a coarse grid ({2^k, 3*2^k}: 1, 2,
+    3, 4, 6, 8, 12, 16, 24, ...): the member count is part of the
+    compiled executable's shape, and the un-quantized rate estimate
+    produced a slightly different count on every run (15/16/17/20/41
+    observed across one round-5 afternoon) — each one a fresh
+    multi-minute remote compile that the persistent XLA cache could have
+    served at a grid value.  Snapping down keeps the 4x call-ceiling
+    margin conservative.
     """
     c = env_int("FCTPU_DETECT_CALL_MEMBERS")
     if c is not None:
         return n_p if c <= 0 else min(c, n_p)
     per = measured_s if measured_s else est_member_seconds(slab, detect, alg)
-    return max(1, min(n_p, int(15.0 / max(per, 1e-9))))
+    raw = max(1, min(n_p, int(15.0 / max(per, 1e-9))))
+    if raw >= n_p:
+        return n_p  # whole-ensemble calls are themselves a stable shape
+    g = 1
+    while 2 * g <= raw or 3 * g <= raw:
+        if 3 * g <= raw < 4 * g:
+            return 3 * g
+        g *= 2
+    return g
 
 
 def read_sizing(cache_dir: str) -> Optional[dict]:
