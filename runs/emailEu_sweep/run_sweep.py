@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""emailEu consensus-lift search (VERDICT r4 #8).
+
+The bench's emailEu stand-in (size-skewed SBM, lpm, tau=0.8) measures
+consensus NMI 0.290 ~= the single-run LPA baseline 0.294 — no lift
+signal.  Sweep tau (the one free consensus knob) and compare three
+quantities per point: single-run LPA NMI (our lpm, one member),
+consensus NMI (partition 0 of the full run), and the consensus mean.
+If no tau lifts consensus above single-run + eps, commit the negative
+result.  CPU backend (quality-only; TPU busy with the 100k flagship).
+Output: runs/emailEu_sweep/results.jsonl
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+sys.path.insert(0, "/root/repo")
+
+import numpy as np  # noqa: E402
+
+BASE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    import jax
+
+    from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
+    from fastconsensus_tpu.graph import pack_edges
+    from fastconsensus_tpu.models.registry import get_detector
+    from fastconsensus_tpu.utils import synth
+    from fastconsensus_tpu.utils.metrics import nmi
+
+    # the bench emailEu stand-in graph (bench.py CONFIGS["emailEu"])
+    n, n_comm, p_in, p_out, alpha = 1005, 42, 0.6, 0.02, 0.85
+    w = np.arange(1, n_comm + 1, dtype=float) ** -alpha
+    sizes = np.maximum((w / w.sum() * n).astype(np.int64), 2)
+    while sizes.sum() > n:
+        sizes[np.argmax(sizes)] -= 1
+    while sizes.sum() < n:
+        sizes[np.argmin(sizes)] += 1
+    edges, truth = synth.planted_partition(n, n_comm, p_in, p_out, seed=42,
+                                           sizes=sizes)
+    det = get_detector("lpm")
+    slab = pack_edges(edges, n)
+
+    # single-run reference: one lpm member, 5 seeds
+    singles = []
+    for s in range(5):
+        lab = np.asarray(det(slab, jax.random.split(jax.random.key(s), 1))[0])
+        singles.append(float(nmi(lab, truth)))
+    single = float(np.mean(singles))
+    print(f"single-run lpm NMI: {single:.4f} "
+          f"(range {min(singles):.4f}-{max(singles):.4f})", flush=True)
+
+    out_path = os.path.join(BASE, "results.jsonl")
+    with open(out_path, "a") as fh:
+        fh.write(json.dumps({"single_run_nmi": single,
+                             "singles": singles}) + "\n")
+        for tau in (0.3, 0.45, 0.6, 0.7, 0.8, 0.9):
+            cfg = ConsensusConfig(algorithm="lpm", n_p=50, tau=tau,
+                                  delta=0.02, seed=0, max_rounds=24)
+            t0 = time.time()
+            res = run_consensus(pack_edges(edges, n), det, cfg)
+            wall = time.time() - t0
+            scores = [float(nmi(res.partitions[i], truth))
+                      for i in range(20)]
+            rec = {"tau": tau, "nmi_first": round(scores[0], 4),
+                   "nmi_mean": round(float(np.mean(scores)), 4),
+                   "rounds": res.rounds, "converged": res.converged,
+                   "wall_s": round(wall, 1),
+                   "lift_vs_single": round(float(np.mean(scores)) - single,
+                                           4)}
+            print(json.dumps(rec), flush=True)
+            fh.write(json.dumps(rec) + "\n")
+            fh.flush()
+
+
+if __name__ == "__main__":
+    main()

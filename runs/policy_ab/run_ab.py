@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Policy-constant sensitivity A/B (VERDICT r4 #7).
+
+STALE_ROUNDS in {3,4,6} and FACTOR_WARM in {0.85,0.9,0.95} (one factor
+at a time around the shipped point), on karate (full size) and an
+lfr10k cell sized for the CPU backend (n_p=16, bounded-6).  Records
+rounds to termination, refresh count, and NMI vs truth.  Quality-only:
+runs on the CPU backend so the TPU stays free for the 100k flagship
+run.  Output: runs/policy_ab/results.jsonl
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+sys.path.insert(0, "/root/repo")
+
+import numpy as np  # noqa: E402
+
+BASE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(BASE, "results.jsonl")
+
+CELLS = [("STALE_ROUNDS", 3), ("STALE_ROUNDS", 4), ("STALE_ROUNDS", 6),
+         ("FACTOR_WARM", 0.85), ("FACTOR_WARM", 0.95)]
+
+
+def run_cell(graph, truth, alg, n_p, max_rounds, knob, value, seed=0):
+    from fastconsensus_tpu import policy
+    from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
+    from fastconsensus_tpu.graph import pack_edges
+    from fastconsensus_tpu.models.registry import get_detector
+    from fastconsensus_tpu.utils.metrics import nmi
+
+    default = getattr(policy, knob)
+    setattr(policy, knob, value)
+    try:
+        slab = pack_edges(graph, int(truth.shape[0]))
+        cfg = ConsensusConfig(algorithm=alg, n_p=n_p, tau=0.2, delta=0.02,
+                              seed=seed, max_rounds=max_rounds)
+        t0 = time.time()
+        res = run_consensus(slab, get_detector(alg), cfg)
+        wall = time.time() - t0
+        scores = [float(nmi(res.partitions[i], truth))
+                  for i in range(min(n_p, 20))]
+        refreshes = sum(1 for h in res.history[1:] if h["cold"])
+        return {"knob": knob, "value": value, "default": default,
+                "rounds": res.rounds, "converged": res.converged,
+                "refreshes": refreshes, "nmi_mean": round(
+                    float(np.mean(scores)), 4), "wall_s": round(wall, 1),
+                "seed": seed}
+    finally:
+        setattr(policy, knob, default)
+
+
+def main():
+    from fastconsensus_tpu.utils.io import read_edgelist
+
+    edges, _, _ = read_edgelist("/root/repo/examples/karate_club.txt")
+    ktruth = np.array([0, 0, 0, 0, 0, 0, 0, 0, 1, 1, 0, 0, 0, 0, 1, 1, 0,
+                       0, 1, 0, 1, 0, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1, 1])
+    e10k = np.loadtxt("/root/repo/runs/lfr10k_r4/graph.txt", dtype=np.int64)
+    t10k = np.load("/root/repo/runs/lfr10k_r4/truth.npy")
+
+    with open(OUT, "a") as fh:
+        for knob, value in CELLS:
+            for seed in (0, 1):
+                r = run_cell(edges, ktruth, "louvain", 20, 24, knob, value,
+                             seed)
+                r["config"] = "karate"
+                print(json.dumps(r), flush=True)
+                fh.write(json.dumps(r) + "\n")
+                fh.flush()
+        for knob, value in CELLS:
+            r = run_cell(e10k, t10k, "leiden", 16, 6, knob, value, 0)
+            r["config"] = "lfr10k_np16"
+            print(json.dumps(r), flush=True)
+            fh.write(json.dumps(r) + "\n")
+            fh.flush()
+
+
+if __name__ == "__main__":
+    main()
