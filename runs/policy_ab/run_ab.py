@@ -13,9 +13,23 @@ import os
 import sys
 import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 sys.path.insert(0, "/root/repo")
+
+# Setting env vars here is too late to stop the sitecustomize-registered
+# axon plugin from hijacking backend selection (it registers at
+# interpreter start): drop its factory before the first jax init, the
+# same workaround tests/conftest.py and __graft_entry__ use.  The first
+# version of this script missed this and silently ran on the TPU tunnel,
+# contending with the 100k flagship run.
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+if not _xb.backends_are_initialized():
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
 
 import numpy as np  # noqa: E402
 
@@ -63,9 +77,21 @@ def main():
     e10k = np.loadtxt("/root/repo/runs/lfr10k_r4/graph.txt", dtype=np.int64)
     t10k = np.load("/root/repo/runs/lfr10k_r4/truth.npy")
 
+    done = set()
+    if os.path.exists(OUT):
+        with open(OUT) as fh:
+            for ln in fh:
+                try:
+                    r = json.loads(ln)
+                except ValueError:
+                    continue  # truncated tail from a killed prior run
+                done.add((r["config"], r["knob"], r["value"],
+                          r.get("seed", 0)))
     with open(OUT, "a") as fh:
         for knob, value in CELLS:
             for seed in (0, 1):
+                if ("karate", knob, value, seed) in done:
+                    continue
                 r = run_cell(edges, ktruth, "louvain", 20, 24, knob, value,
                              seed)
                 r["config"] = "karate"
@@ -73,6 +99,8 @@ def main():
                 fh.write(json.dumps(r) + "\n")
                 fh.flush()
         for knob, value in CELLS:
+            if ("lfr10k_np16", knob, value, 0) in done:
+                continue
             r = run_cell(e10k, t10k, "leiden", 16, 6, knob, value, 0)
             r["config"] = "lfr10k_np16"
             print(json.dumps(r), flush=True)

@@ -126,3 +126,19 @@ def test_derive_agg_sizing_bounds():
         assert cap >= e            # lossless at derivation time
         assert cap % 4096 == 0
         assert cap <= e + e // 8 + 1024 + 4096  # tight slack
+
+
+def test_members_per_call_grid_quantization(monkeypatch):
+    """Call sizing must land on the {2^k, 3*2^k} shape grid (round 5):
+    raw rate-derived counts compiled a fresh executable per run."""
+    from fastconsensus_tpu import sizing
+
+    edges = np.array([[i, i + 1] for i in range(200)])
+    slab = pack_edges(edges, 201)
+    monkeypatch.delenv("FCTPU_DETECT_CALL_MEMBERS", raising=False)
+    grid = {1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 96, 128}
+    for per in (0.9, 1.3, 1.9, 3.7, 0.37, 0.25, 0.16):
+        m = sizing.members_per_call(slab, 100, measured_s=per)
+        assert m in grid or m == 100, (per, m)
+    # whole-ensemble calls pass through un-snapped (stable shape already)
+    assert sizing.members_per_call(slab, 7, measured_s=0.01) == 7
