@@ -51,6 +51,14 @@ from typing import List, NamedTuple
 
 # Rounds without a strict new unconverged-FRACTION minimum before the
 # stale refresh fires.
+# Sensitivity (round-5 A/B, runs/policy_ab, per-cell-fresh traces):
+# STALE_ROUNDS 3 and 4 are a plateau on karate's limit-cycle dynamics
+# (±1 round, ±0.004 NMI over 2 seeds) while 6 detects the cycle too
+# late — one seed burned its whole 24-round budget unconverged.
+# FACTOR_WARM is inert within ±0.05 everywhere tested.  Monotone
+# trajectories (bounded-6 lfr10k) never engage either rule.  A family
+# oscillating at period > STALE_ROUNDS would still evade the stale
+# rule — the A/B bounds sensitivity, not universality.
 STALE_ROUNDS = 4
 
 # One-step relative-progress factors: a warm round must shrink the

@@ -87,11 +87,19 @@ def members_per_call(slab: GraphSlab, n_p: int,
     """How many ensemble members one detection device-call should carry.
 
     Targets ~15 s per call (a 4x safety margin under the tunnel's ~60 s
-    execute ceiling).  Per-member time: ``measured_s`` — the actual
-    on-device rate from this run's own detection calls — or, before
-    anything has been measured in this process, the
-    :func:`est_member_seconds` prior.  FCTPU_DETECT_CALL_MEMBERS overrides
-    everything (<= 0 disables splitting).
+    execute ceiling).  A ~30 s measured-rate target was tried in round 5
+    to amortize per-call fixed costs (the hybrid build's full-slab sort)
+    and cut dispatch count — and MEASURED NET-NEGATIVE on the 100k
+    config: doubling the batch 4 -> 8 members doubled the per-member
+    cost (3.4 -> 6.9 s; the vmapped sweep while-loop runs to the
+    slowest member, so wider batches accumulate stragglers) and the
+    resulting 55-63 s calls brushed the tunnel's execute-kill ceiling,
+    triggering the very wedges fewer dispatches were meant to avoid.
+    Per-member time: ``measured_s`` — the actual on-device rate from
+    this run's own detection calls — or, before anything has been
+    measured in this process, the :func:`est_member_seconds` prior.
+    FCTPU_DETECT_CALL_MEMBERS overrides everything (<= 0 disables
+    splitting).
 
     The raw count is snapped DOWN to a coarse grid ({2^k, 3*2^k}: 1, 2,
     3, 4, 6, 8, 12, 16, 24, ...): the member count is part of the

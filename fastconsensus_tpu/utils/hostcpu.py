@@ -1,0 +1,30 @@
+"""Force the CPU backend in environments with the axon TPU plugin.
+
+The tunnel plugin registers itself from sitecustomize at interpreter
+start and hijacks backend selection even under ``JAX_PLATFORMS=cpu``
+(setting env vars inside Python is too late).  This is the one canonical
+copy of the workaround — tests/conftest.py and __graft_entry__ carry
+historical inline variants with extra context-specific guards; new
+host-side scripts should call this.
+
+Call before the first jax backend initialization; asserts loudly if a
+backend is already up on something other than CPU (a silent TPU fallback
+is how the round-5 policy A/B initially contended with the 100k
+flagship run).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    if not _xb.backends_are_initialized():
+        _xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+    assert jax.default_backend() == "cpu", jax.default_backend()

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""Score the round-5 lfr100k run: NMI vs planted truth + trajectory."""
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+BASE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    from fastconsensus_tpu.utils.metrics import nmi
+
+    truth = np.load(os.path.join(BASE, "..", "lfr100k_r4", "truth.npy"))
+    rows = []
+    with open(os.path.join(BASE, "rounds.jsonl")) as fh:
+        rows = [json.loads(ln) for ln in fh if ln.strip()]
+    by_round = {}
+    for r in rows:
+        by_round[r["round"]] = r  # last write wins (replays/resumes)
+    rounds = sorted(by_round)
+    wall = sum(by_round[r].get("round_seconds", 0) for r in rounds)
+    last = by_round[rounds[-1]]
+    out = {
+        "rounds": len(rounds),
+        "sum_round_seconds": round(wall, 1),
+        "final_unconverged_frac": round(
+            last["n_unconverged"] / max(last["n_alive"], 1), 4),
+        "n_alive_last": last["n_alive"],
+    }
+    mdirs = glob.glob(os.path.join(BASE, "memberships_*"))
+    if mdirs:
+        scores = []
+        for f in sorted(glob.glob(os.path.join(mdirs[0], "*")),
+                        key=lambda p: int(os.path.basename(p)))[:20]:
+            pairs = np.loadtxt(f, dtype=np.int64)
+            lab = np.zeros(truth.shape[0], np.int64)
+            lab[pairs[:, 0] - 1] = pairs[:, 1]
+            scores.append(float(nmi(lab, truth)))
+        out["nmi_mean20"] = round(float(np.mean(scores)), 4)
+        out["nmi_first"] = round(scores[0], 4)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,24 +13,13 @@ import os
 import sys
 import time
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["PALLAS_AXON_POOL_IPS"] = ""
 sys.path.insert(0, "/root/repo")
 
-# Setting env vars here is too late to stop the sitecustomize-registered
-# axon plugin from hijacking backend selection (it registers at
-# interpreter start): drop its factory before the first jax init, the
-# same workaround tests/conftest.py and __graft_entry__ use.  The first
-# version of this script missed this and silently ran on the TPU tunnel,
-# contending with the 100k flagship run.
+from fastconsensus_tpu.utils.hostcpu import force_cpu_backend  # noqa: E402
+
+force_cpu_backend()
+
 import jax  # noqa: E402
-import jax._src.xla_bridge as _xb  # noqa: E402
-
-if not _xb.backends_are_initialized():
-    _xb._backend_factories.pop("axon", None)
-    jax.config.update("jax_platforms", "cpu")
-assert jax.default_backend() == "cpu", jax.default_backend()
-
 import numpy as np  # noqa: E402
 
 BASE = os.path.dirname(os.path.abspath(__file__))
@@ -47,6 +36,15 @@ def run_cell(graph, truth, alg, n_p, max_rounds, knob, value, seed=0):
     from fastconsensus_tpu.models.registry import get_detector
     from fastconsensus_tpu.utils.metrics import nmi
 
+    # The fused-rounds block reads the policy constants at TRACE time and
+    # is lru-cached on shapes only (engine._jitted_rounds_block): without
+    # clearing, every cell after the first reuses the first cell's baked
+    # constants and the A/B silently measures nothing (round-5 review).
+    from fastconsensus_tpu import engine
+
+    engine._jitted_rounds_block.cache_clear()
+    engine._jitted_round.cache_clear()
+    engine._jitted_tail.cache_clear()
     default = getattr(policy, knob)
     setattr(policy, knob, value)
     try:
