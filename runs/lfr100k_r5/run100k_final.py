@@ -1,0 +1,18 @@
+import logging, os, sys, time
+sys.path.insert(0, "/root/repo")
+logging.basicConfig(level=logging.INFO,
+                    format="%(asctime)s %(levelname)s %(message)s")
+logging.getLogger("fastconsensus_tpu").setLevel(logging.DEBUG)
+d = os.path.dirname(os.path.abspath(__file__))
+sys.argv = ["cli", "-f", os.path.join(d, "..", "lfr100k_r4", "graph.txt"),
+            "--alg", "louvain", "-np", "200", "-t", "0.2", "-d", "0.02",
+            "--seed", "0", "--max-rounds", "8", "--closure-tau", "0.2",
+            "--checkpoint", os.path.join(d, "ck.npz"), "--resume",
+            "--detect-cache", os.path.join(d, "cache"),
+            "--trace-jsonl", os.path.join(d, "rounds.jsonl")]
+os.chdir(d)
+from fastconsensus_tpu import cli
+t0 = time.time()
+rc = cli.main()
+print(f"END_TO_END {time.time()-t0:.1f}s rc={rc}", flush=True)
+sys.exit(rc or 0)
