@@ -1,0 +1,55 @@
+"""fcheck: the project's static-analysis suite (AST lint + jaxpr audit +
+recompile guard).
+
+Three layers, one report (run ``python -m fastconsensus_tpu.analysis``):
+
+1. **AST lint** (analysis/astlint.py) — project-specific source rules:
+   PRNG key reuse, Python control flow on traced values, retrace
+   hazards, weak static args, float64 drift, host syncs in hot loops,
+   Pallas kernels closing over tracers.
+2. **jaxpr audit** (analysis/jaxpr_audit.py) — traces every registered
+   jitted entry point (analysis/entrypoints.py) at canonical shapes and
+   walks the staged program for forbidden primitives (f64 casts,
+   embedded device_put, ungated huge gathers).
+3. **recompile guard** (analysis/recompile_guard.py) — a runtime context
+   manager bounding XLA compilations over a region; the tier-1 test
+   pins the 2-round consensus compile budget with it.
+
+CI gates on a clean run (scripts/ci_check.sh); deliberate violations
+carry ``# fcheck: ok=<rule>`` pragmas with reasons
+(analysis/diagnostics.py).
+"""
+
+from fastconsensus_tpu.analysis.diagnostics import (Diagnostic,  # noqa: F401
+                                                    Report)
+from fastconsensus_tpu.analysis.recompile_guard import (  # noqa: F401
+    CompileGuard, RecompileError, assert_max_compiles)
+
+
+def lint_paths(paths, report=None):
+    """Lint every ``.py`` under ``paths`` (files or directories) into a
+    Report (created if not given)."""
+    import os
+
+    from fastconsensus_tpu.analysis.astlint import lint_source
+
+    if report is None:
+        report = Report()
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", "build"))
+                files.extend(os.path.join(root, f) for f in sorted(names)
+                             if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    for f in files:
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        diags, suppressed = lint_source(src, filename=f)
+        report.extend(diags)
+        report.n_suppressed += suppressed
+        report.n_files += 1
+    return report

@@ -1,0 +1,96 @@
+"""CLI: ``python -m fastconsensus_tpu.analysis [paths...]``.
+
+Exit codes: 0 = clean, 1 = diagnostics found, 2 = analyzer internal
+error.  With no paths, lints the ``fastconsensus_tpu`` package itself.
+
+The jaxpr audit (which imports jax and traces the engine) runs by
+default whenever a scanned path lies inside the package — so the CI
+invocation audits everything, while pointing the tool at fixture
+snippets stays import-free and fast.  ``--jaxpr`` / ``--no-jaxpr``
+override.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+
+def _inside_package(paths: List[str]) -> bool:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for p in paths:
+        ap = os.path.abspath(p)
+        if ap == pkg or ap.startswith(pkg + os.sep) or \
+                pkg.startswith(ap + os.sep):
+            return True
+    return False
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fastconsensus_tpu.analysis",
+        description="fcheck: AST lint + jaxpr audit for the "
+                    "fastconsensus_tpu codebase")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories to lint (default: the "
+                             "fastconsensus_tpu package)")
+    parser.add_argument("--json", metavar="PATH", default=None,
+                        help="write the machine-readable report here")
+    parser.add_argument("--jaxpr", dest="jaxpr", action="store_true",
+                        default=None, help="force the jaxpr audit on")
+    parser.add_argument("--no-jaxpr", dest="jaxpr", action="store_false",
+                        help="skip the jaxpr audit (pure source lint)")
+    parser.add_argument("--entry-point", action="append", default=None,
+                        metavar="NAME",
+                        help="audit only these entry points (repeatable)")
+    parser.add_argument("--gather-threshold", type=int, default=1 << 26,
+                        help="jaxpr audit: max elements one gather may "
+                             "materialize (default 2^26)")
+    parser.add_argument("--quiet", action="store_true",
+                        help="suppress per-diagnostic output")
+    args = parser.parse_args(argv)
+
+    from fastconsensus_tpu.analysis import Report, lint_paths
+
+    paths = args.paths or [os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))]
+    report = Report()
+    try:
+        lint_paths(paths, report)
+    except OSError as e:
+        print(f"fcheck: cannot read {e.filename or e}: {e.strerror or e}",
+              file=sys.stderr)
+        return 2
+
+    run_jaxpr = args.jaxpr
+    if run_jaxpr is None:
+        run_jaxpr = _inside_package(paths)
+    if run_jaxpr:
+        try:
+            from fastconsensus_tpu.analysis.jaxpr_audit import \
+                audit_entry_points
+
+            diags, summary = audit_entry_points(
+                names=args.entry_point,
+                gather_threshold=args.gather_threshold)
+            report.extend(diags)
+            report.jaxpr_summary = summary
+        except Exception as e:  # noqa: BLE001 — analyzer must not crash CI
+            print(f"fcheck: jaxpr audit failed to run: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+
+    if args.json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.json)),
+                    exist_ok=True)
+        with open(args.json, "w", encoding="utf-8") as fh:
+            fh.write(report.to_json())
+    if not args.quiet:
+        print(report.format_human())
+    return 1 if report.diagnostics else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,738 @@
+"""Layer 1 of fcheck: project-specific AST lint rules for JAX/TPU code.
+
+The rules encode the device-side discipline this codebase's correctness
+hinges on (module docstrings of ops/pallas_kernels.py, utils/prng.py,
+engine.py) — invariants no runtime test can see because violating them
+changes *performance* or *distributions*, not output shapes:
+
+``key-reuse``
+    The same PRNG key consumed by two draws on one execution path (or by
+    a draw inside a Python loop with the key derived outside it).  JAX
+    keys are not stateful; reuse silently correlates draws
+    (utils/prng.py's single-tree contract).
+
+``traced-branch``
+    Python ``if``/``while`` tests (or ``bool()`` casts) built from
+    ``jnp.*`` calls.  Inside jit this is a tracer leak
+    (ConcretizationTypeError at best); outside it is a hidden
+    device->host sync.  Device-side control flow belongs to ``lax.cond``
+    / ``lax.while_loop``; host-side predicates belong to numpy.
+
+``retrace-risk``
+    ``jax.jit`` called in a local scope without an ``lru_cache``-style
+    decorator on the builder: jit keys its executable cache on the
+    function object, so a fresh wrapper per call recompiles every time
+    (engine.py:_jitted_round, measured ~18 s/run on the TPU tunnel).
+
+``weak-static-arg``
+    Static jit parameters that are positional (``static_argnums`` —
+    silently wrong under keyword calls / partials) or carry unhashable
+    (mutable) defaults, both of which force or break retraces.
+
+``f64-dtype``
+    ``float64`` reaching a ``jnp`` array: TPUs have no f64; with x64
+    enabled this doubles memory and falls off the fast path, with it
+    disabled it silently downcasts.  Host-side ``np.float64`` is fine
+    and not flagged.
+
+``sync-in-loop``
+    ``.item()`` / ``.block_until_ready()`` / ``jax.device_get`` /
+    ``np.asarray`` inside a Python loop — per-iteration host-device
+    round-trips, the classic hot-loop killer (engine.py's bulk-readback
+    notes).  Deliberate once-per-round readbacks carry a
+    ``# fcheck: ok=sync-in-loop`` pragma with the reason.
+
+``kernel-tracer-closure``
+    A Pallas kernel body (a function passed to ``pl.pallas_call``)
+    defined in a local scope with free variables: closing over traced
+    arrays breaks Mosaic lowering (ops/pallas_kernels.py:31-33).
+    Kernels must be module-level functions taking everything through
+    refs or static ``functools.partial`` binds.
+
+``module-jnp-const``
+    Module-level ``jnp.*`` constant: materializes a device array at
+    import time (before backend/mesh configuration) and, captured in a
+    kernel, violates the closure rule above.
+
+All rules support ``# fcheck: ok=<rule>`` suppression pragmas
+(diagnostics.parse_pragmas).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from fastconsensus_tpu.analysis.diagnostics import (Diagnostic,
+                                                    apply_pragmas)
+
+# jax.random functions that *derive* keys (safe to call repeatedly on one
+# key with different data) vs those that *consume* a key for a draw.
+_KEY_DERIVERS = {
+    "split", "fold_in", "key", "PRNGKey", "wrap_key_data", "key_data",
+    "clone", "stream", "partition_keys",
+}
+_KEY_DRAWS = {
+    "uniform", "normal", "bernoulli", "randint", "bits", "choice",
+    "permutation", "categorical", "gumbel", "exponential", "laplace",
+    "logistic", "truncated_normal", "beta", "dirichlet", "gamma",
+    "poisson", "rademacher", "maxwell", "ball", "orthogonal", "t",
+}
+# jnp calls whose result in a Python bool context is a traced-value leak
+# (reductions / predicates); elementwise math is excluded to keep the
+# rule precise.
+_TRACED_PREDICATES = {
+    "any", "all", "sum", "max", "min", "mean", "prod", "count_nonzero",
+    "isfinite", "isnan", "isinf", "array_equal", "allclose", "isclose",
+    "logical_and", "logical_or", "logical_not", "equal", "not_equal",
+    "greater", "less", "greater_equal", "less_equal", "where", "argmax",
+    "argmin",
+}
+_SYNC_CALLS_ATTR = {"item", "block_until_ready"}
+_F64_NAMES = {"float64", "double", "complex128"}
+
+
+def _scope_nodes(fn: ast.AST):
+    """Yield nodes in ``fn``'s own scope, skipping nested function bodies
+    (each nested def is linted as its own function)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _call_name(node: ast.Call) -> Tuple[Optional[str], str]:
+    """(module-ish qualifier, attr/function name) of a call target."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return None, f.id
+    if isinstance(f, ast.Attribute):
+        qual = None
+        v = f.value
+        parts = []
+        while isinstance(v, ast.Attribute):
+            parts.append(v.attr)
+            v = v.value
+        if isinstance(v, ast.Name):
+            parts.append(v.id)
+            qual = ".".join(reversed(parts))
+        return qual, f.attr
+    return None, ""
+
+
+def _is_jaxish(qual: Optional[str]) -> bool:
+    return qual is not None and (
+        qual in ("jnp", "jax", "lax", "np_like") or
+        qual.startswith("jax.") or qual.startswith("jnp."))
+
+
+def _is_random_qual(qual: Optional[str]) -> bool:
+    return qual is not None and (
+        qual.endswith("random") or qual in ("prng",))
+
+
+def _is_key_deriver(qual: Optional[str], name: str) -> bool:
+    """A call that re-derives keys rather than consuming one.
+
+    The qualifier must look PRNG-ish: ``line.split()`` (str.split) and
+    other name collisions must not count as key derivations.
+    """
+    return name in _KEY_DERIVERS and _is_random_qual(qual)
+
+
+def _contains_jnp_predicate(expr: ast.AST) -> Optional[ast.Call]:
+    """A jnp reduction/predicate call anywhere inside ``expr``, if any."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            qual, name = _call_name(node)
+            if name in _TRACED_PREDICATES and qual is not None and (
+                    qual == "jnp" or qual.startswith("jnp.") or
+                    qual in ("jax.numpy",)):
+                return node
+    return None
+
+
+class _KeyState:
+    """Per-path PRNG-key consumption counts, alias-aware.
+
+    ``depth`` records the loop depth a key was derived at: consuming a
+    key inside a loop it was derived OUTSIDE of counts double (the
+    consumption repeats per iteration with the same key), while a key
+    derived fresh each iteration is fine.
+    """
+
+    def __init__(self) -> None:
+        self.alias: Dict[str, str] = {}   # name -> canonical key name
+        self.count: Dict[str, int] = {}   # canonical -> consumptions
+        self.depth: Dict[str, int] = {}   # canonical -> derivation depth
+        self.site: Dict[str, Tuple[int, int]] = {}  # first consumption
+
+    def canon(self, name: str) -> Optional[str]:
+        return self.alias.get(name)
+
+    def fresh(self, name: str, depth: int = 0) -> None:
+        self.alias[name] = name
+        self.count[name] = 0
+        self.depth[name] = depth
+
+    def drop(self, name: str) -> None:
+        self.alias.pop(name, None)
+
+    def copy(self) -> "_KeyState":
+        s = _KeyState()
+        s.alias = dict(self.alias)
+        s.count = dict(self.count)
+        s.depth = dict(self.depth)
+        s.site = dict(self.site)
+        return s
+
+    def merge_max(self, *others: "_KeyState") -> None:
+        for o in others:
+            for k, v in o.count.items():
+                if v > self.count.get(k, 0):
+                    self.count[k] = v
+                    if k in o.site:
+                        self.site[k] = o.site[k]
+            self.alias.update(o.alias)
+            self.depth.update(o.depth)
+
+
+class Linter:
+    def __init__(self, source: str, filename: str = "<memory>") -> None:
+        self.source = source
+        self.filename = filename
+        self.diags: List[Diagnostic] = []
+        self.n_suppressed = 0
+
+    def run(self) -> List[Diagnostic]:
+        try:
+            tree = ast.parse(self.source, filename=self.filename)
+        except SyntaxError as e:
+            self.diags.append(Diagnostic(
+                rule="syntax-error", message=str(e.msg),
+                file=self.filename, line=e.lineno or 0, col=e.offset or 0))
+            return self.diags
+        self._module_level(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_function(node)
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+        self.diags, self.n_suppressed = apply_pragmas(self.diags,
+                                                      self.source)
+        return self.diags
+
+    def _diag(self, rule: str, node: ast.AST, message: str) -> None:
+        self.diags.append(Diagnostic(
+            rule=rule, message=message, file=self.filename,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0)))
+
+    # ---------------- module level ----------------
+
+    def _module_level(self, tree: ast.Module) -> None:
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                value = stmt.value
+                if isinstance(value, ast.Call):
+                    qual, _ = _call_name(value)
+                    if qual == "jnp" or qual == "jax.numpy":
+                        self._diag(
+                            "module-jnp-const", stmt,
+                            "module-level jnp constant materializes a "
+                            "device array at import time (and would break "
+                            "kernel closures); use a Python scalar or "
+                            "build it inside the jitted function")
+
+    # ---------------- per-call rules ----------------
+
+    def _check_call(self, node: ast.Call) -> None:
+        qual, name = _call_name(node)
+        self._check_f64(node, qual, name)
+        if (qual == "pl" or (qual or "").endswith("pallas")) and \
+                name == "pallas_call":
+            # handled per-function for closure analysis; nothing here
+            pass
+
+    def _check_f64(self, node: ast.Call, qual: Optional[str],
+                   name: str) -> None:
+        """float64 flowing into jnp/jax calls (dtype= kwarg, astype,
+        jnp.float64 references)."""
+        jaxish = qual is not None and (
+            qual == "jnp" or qual == "jax.numpy" or qual.startswith("jax"))
+        for kw in node.keywords:
+            if kw.arg == "dtype" and self._is_f64_expr(kw.value) and jaxish:
+                self._diag("f64-dtype", node,
+                           f"float64 dtype passed to {qual}.{name} — TPUs "
+                           "have no f64 path (silently downcast or 2x "
+                           "memory); use float32/int32")
+        if name == "astype":
+            for arg in node.args:
+                if self._is_f64_expr(arg):
+                    self._diag("f64-dtype", node,
+                               "astype to float64 in array code; use "
+                               "float32 (host-side np arrays are exempt "
+                               "— move the cast to numpy if intended)")
+
+    @staticmethod
+    def _is_f64_expr(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Attribute) and expr.attr in _F64_NAMES:
+            qual = None
+            if isinstance(expr.value, ast.Name):
+                qual = expr.value.id
+            return qual in ("jnp", "np", "numpy", "jax")
+        if isinstance(expr, ast.Constant) and expr.value in (
+                "float64", "double", "complex128"):
+            return True
+        if isinstance(expr, ast.Name) and expr.id == "float":
+            # dtype=float means float64 under x64 — ambiguous, flag it
+            return True
+        return False
+
+    # ---------------- per-function rules ----------------
+
+    def _check_function(self, fn: ast.FunctionDef) -> None:
+        self._check_key_reuse(fn)
+        self._check_traced_branch(fn)
+        self._check_retrace(fn)
+        self._check_static_args(fn)
+        self._check_sync_in_loop(fn)
+        self._check_kernel_closures(fn)
+
+    # -- key-reuse ---------------------------------------------------
+
+    def _check_key_reuse(self, fn: ast.FunctionDef) -> None:
+        state = _KeyState()
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            n = a.arg
+            if n == "key" or n == "rng" or n.endswith("_key") or \
+                    n == "keys" or n.endswith("_keys"):
+                state.fresh(n)
+        self._walk_keys(list(fn.body), state, loop_depth=0,
+                        skip_defs=True)
+
+    def _consume(self, state: _KeyState, name: str, node: ast.AST,
+                 weight: int) -> None:
+        canon = state.canon(name)
+        if canon is None:
+            return
+        state.count[canon] = state.count.get(canon, 0) + weight
+        if canon not in state.site:
+            state.site[canon] = (getattr(node, "lineno", 0),
+                                 getattr(node, "col_offset", 0))
+        if state.count[canon] >= 2:
+            self._diag(
+                "key-reuse", node,
+                f"PRNG key {name!r} consumed more than once on one "
+                "execution path; split/fold_in a fresh subkey per "
+                "consumer (utils/prng.py)")
+            # report once per key
+            state.drop(name)
+            state.count.pop(canon, None)
+
+    def _key_expr_handling(self, state: _KeyState, value: ast.AST,
+                           targets: List[ast.expr], node: ast.AST,
+                           loop_depth: int) -> bool:
+        """Handle an assignment whose RHS may derive or alias keys.
+        Returns True if the assignment was key-related."""
+        # alias: k2 = k1
+        if isinstance(value, ast.Name) and state.canon(value.id):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    state.alias[t.id] = state.canon(value.id)
+            return True
+        if isinstance(value, ast.Call):
+            qual, name = _call_name(value)
+            if _is_key_deriver(qual, name):
+                # deriving consumes nothing; targets become fresh keys
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        state.fresh(t.id, loop_depth)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for el in t.elts:
+                            if isinstance(el, ast.Name):
+                                state.fresh(el.id, loop_depth)
+                return True
+        return False
+
+    def _walk_keys(self, stmts: List[ast.stmt], state: _KeyState,
+                   loop_depth: int, skip_defs: bool = False) -> bool:
+        """Walk statements tracking key consumption; returns True if this
+        block terminates (return/raise) so callers skip merging it."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs are linted as their own functions
+            if isinstance(stmt, ast.Return):
+                if stmt.value is not None:
+                    self._scan_expr_keys(stmt.value, state, loop_depth)
+                return True
+            if isinstance(stmt, ast.Raise):
+                return True
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                return True
+            if isinstance(stmt, ast.Assign):
+                if not self._key_expr_handling(state, stmt.value,
+                                               stmt.targets, stmt,
+                                               loop_depth):
+                    self._scan_expr_keys(stmt.value, state, loop_depth)
+                    # reassignment from a non-key expr kills key tracking
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            state.drop(t.id)
+                continue
+            if isinstance(stmt, ast.AugAssign):
+                self._scan_expr_keys(stmt.value, state, loop_depth)
+                continue
+            if isinstance(stmt, ast.If):
+                s_body = state.copy()
+                s_else = state.copy()
+                self._scan_expr_keys(stmt.test, state, loop_depth)
+                t_body = self._walk_keys(stmt.body, s_body, loop_depth)
+                t_else = self._walk_keys(stmt.orelse, s_else, loop_depth)
+                live = [s for s, t in ((s_body, t_body), (s_else, t_else))
+                        if not t]
+                if live:
+                    state.alias.clear()
+                    state.count.clear()
+                    state.merge_max(*live)
+                elif t_body and t_else:
+                    return True
+                continue
+            if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    self._scan_expr_keys(stmt.iter, state, loop_depth)
+                else:
+                    self._scan_expr_keys(stmt.test, state, loop_depth)
+                s_loop = state.copy()
+                self._walk_keys(stmt.body, s_loop, loop_depth + 1)
+                state.merge_max(s_loop)
+                self._walk_keys(stmt.orelse, state, loop_depth)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._scan_expr_keys(item.context_expr, state,
+                                         loop_depth)
+                if self._walk_keys(stmt.body, state, loop_depth):
+                    return True
+                continue
+            if isinstance(stmt, ast.Try):
+                if self._walk_keys(stmt.body, state, loop_depth):
+                    return True
+                for h in stmt.handlers:
+                    self._walk_keys(h.body, state.copy(), loop_depth)
+                self._walk_keys(stmt.orelse, state, loop_depth)
+                self._walk_keys(stmt.finalbody, state, loop_depth)
+                continue
+            if isinstance(stmt, ast.Expr):
+                self._scan_expr_keys(stmt.value, state, loop_depth)
+                continue
+            # anything else: scan expressions conservatively
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._scan_expr_keys(child, state, loop_depth)
+        return False
+
+    def _scan_expr_keys(self, expr: ast.AST, state: _KeyState,
+                        loop_depth: int) -> None:
+        """Count key consumptions inside an expression.
+
+        A bare key name passed as an argument to a call counts as one
+        consumption — unless the callee is a pure key *deriver*
+        (split/fold_in/...), which may be called repeatedly.  Inside a
+        Python loop a consumption of a key derived *outside* the loop
+        counts double (it repeats every iteration).
+        """
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            qual, name = _call_name(node)
+            derives = _is_key_deriver(qual, name)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and state.canon(arg.id):
+                    if derives:
+                        continue
+                    canon = state.canon(arg.id)
+                    weight = 2 if loop_depth > state.depth.get(canon, 0) \
+                        else 1
+                    self._consume(state, arg.id, node, weight)
+
+    # -- traced-branch ----------------------------------------------
+
+    def _check_traced_branch(self, fn: ast.FunctionDef) -> None:
+        for node in _scope_nodes(fn):
+            test = None
+            what = None
+            if isinstance(node, (ast.If, ast.IfExp)):
+                test, what = node.test, "if"
+            elif isinstance(node, ast.While):
+                test, what = node.test, "while"
+            elif isinstance(node, ast.Assert):
+                test, what = node.test, "assert"
+            elif isinstance(node, ast.Call):
+                q, n = _call_name(node)
+                if q is None and n == "bool" and node.args:
+                    test, what = node.args[0], "bool()"
+            if test is None:
+                continue
+            hit = _contains_jnp_predicate(test)
+            if hit is not None:
+                _, pname = _call_name(hit)
+                self._diag(
+                    "traced-branch", node,
+                    f"Python {what} on jnp.{pname}(...): a traced value "
+                    "in host control flow (ConcretizationTypeError under "
+                    "jit, hidden device sync outside); use lax.cond/"
+                    "lax.while_loop or numpy for host predicates")
+
+    # -- retrace-risk ------------------------------------------------
+
+    @staticmethod
+    def _decorator_names(fn: ast.FunctionDef) -> List[str]:
+        out = []
+        for dec in fn.decorator_list:
+            node = dec.func if isinstance(dec, ast.Call) else dec
+            if isinstance(node, ast.Attribute):
+                out.append(node.attr)
+            elif isinstance(node, ast.Name):
+                out.append(node.id)
+        return out
+
+    def _check_retrace(self, fn: ast.FunctionDef) -> None:
+        decs = self._decorator_names(fn)
+        cached = any(d in ("lru_cache", "cache") for d in decs)
+        if cached:
+            return
+        for node in _scope_nodes(fn):
+            if isinstance(node, ast.Call):
+                qual, name = _call_name(node)
+                if name == "jit" and qual in ("jax", "jax.experimental"):
+                    # direct call producing a jitted fn inside a plain
+                    # function body: a fresh wrapper (and executable
+                    # cache) per invocation
+                    self._diag(
+                        "retrace-risk", node,
+                        "jax.jit called inside a function without "
+                        "lru_cache: every call builds a fresh wrapper "
+                        "and recompiles (cache keys on the function "
+                        "object — engine.py:_jitted_round)")
+
+    # -- weak-static-arg --------------------------------------------
+
+    def _check_static_args(self, fn: ast.FunctionDef) -> None:
+        static_names: Set[str] = set()
+        for dec in fn.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            qual, name = _call_name(dec)
+            inner_jit = name == "jit"
+            if name == "partial" and dec.args:
+                q2, n2 = _call_name(ast.Call(func=dec.args[0], args=[],
+                                             keywords=[])) \
+                    if isinstance(dec.args[0],
+                                  (ast.Attribute, ast.Name)) else (None, "")
+                inner_jit = n2 == "jit"
+            if not inner_jit:
+                continue
+            for kw in dec.keywords:
+                if kw.arg == "static_argnums":
+                    self._diag(
+                        "weak-static-arg", dec,
+                        "static_argnums is positional: silently wrong "
+                        "under keyword calls and partials; use "
+                        "static_argnames")
+                if kw.arg == "static_argnames":
+                    for el in ast.walk(kw.value):
+                        if isinstance(el, ast.Constant) and \
+                                isinstance(el.value, str):
+                            static_names.add(el.value)
+        if not static_names:
+            return
+        args = fn.args
+        named = args.posonlyargs + args.args + args.kwonlyargs
+        defaults = list(args.defaults)
+        # align defaults with trailing positional args
+        pos = args.posonlyargs + args.args
+        pairs = list(zip(pos[len(pos) - len(defaults):], defaults)) + \
+            [(a, d) for a, d in zip(args.kwonlyargs, args.kw_defaults)
+             if d is not None]
+        for a, d in pairs:
+            if a.arg in static_names and isinstance(
+                    d, (ast.List, ast.Dict, ast.Set)):
+                self._diag(
+                    "weak-static-arg", a,
+                    f"static arg {a.arg!r} has an unhashable (mutable) "
+                    "default: jit static args must hash; use a tuple or "
+                    "None sentinel")
+        for a in named:
+            if a.arg in static_names:
+                ann = a.annotation
+                if isinstance(ann, ast.Name) and ann.id in ("list",
+                                                            "dict",
+                                                            "set"):
+                    self._diag(
+                        "weak-static-arg", a,
+                        f"static arg {a.arg!r} annotated as unhashable "
+                        f"{ann.id}; jit static args must hash")
+
+    # -- sync-in-loop ------------------------------------------------
+
+    def _sync_call_name(self, node: ast.Call) -> Optional[str]:
+        qual, name = _call_name(node)
+        if name in _SYNC_CALLS_ATTR and isinstance(node.func,
+                                                   ast.Attribute):
+            return f".{name}()"
+        if qual == "jax" and name == "device_get":
+            return "jax.device_get"
+        if qual in ("np", "numpy") and name in ("asarray", "array"):
+            return f"np.{name}"
+        return None
+
+    def _check_sync_in_loop(self, fn: ast.FunctionDef) -> None:
+        def check_stmt_exprs(stmt: ast.stmt) -> None:
+            """Flag sync calls in one simple statement, skipping nested
+            function/lambda bodies."""
+            stack = [stmt]
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue
+                if isinstance(node, ast.Call):
+                    sync = self._sync_call_name(node)
+                    if sync:
+                        self._diag(
+                            "sync-in-loop", node,
+                            f"{sync} inside a Python loop: a host-device "
+                            "sync per iteration; batch the readback "
+                            "outside the loop (or pragma with the reason "
+                            "if this loop IS the host driver)")
+                stack.extend(ast.iter_child_nodes(node))
+
+        def scan(stmts: List[ast.stmt], in_loop: bool) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(stmt, (ast.For, ast.While, ast.AsyncFor)):
+                    scan(stmt.body, True)
+                    scan(stmt.orelse, in_loop)
+                    continue
+                if isinstance(stmt, ast.If):
+                    if in_loop:
+                        check_stmt_exprs(stmt.test)
+                    scan(stmt.body, in_loop)
+                    scan(stmt.orelse, in_loop)
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    if in_loop:
+                        for item in stmt.items:
+                            check_stmt_exprs(item.context_expr)
+                    scan(stmt.body, in_loop)
+                    continue
+                if isinstance(stmt, ast.Try):
+                    scan(stmt.body, in_loop)
+                    for h in stmt.handlers:
+                        scan(h.body, in_loop)
+                    scan(stmt.orelse, in_loop)
+                    scan(stmt.finalbody, in_loop)
+                    continue
+                if in_loop:
+                    check_stmt_exprs(stmt)
+
+        scan(fn.body, False)
+
+    # -- kernel-tracer-closure --------------------------------------
+
+    def _check_kernel_closures(self, fn: ast.FunctionDef) -> None:
+        """Kernel functions passed to pallas_call must not be local defs
+        with free variables (they would close over traced arrays)."""
+        local_defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.FunctionDef) and node is not fn:
+                local_defs[node.name] = node
+        for node in _scope_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            qual, name = _call_name(node)
+            if name != "pallas_call" or not node.args:
+                continue
+            kernel = node.args[0]
+            # unwrap functools.partial(kernel, ...)
+            if isinstance(kernel, ast.Call):
+                kq, kn = _call_name(kernel)
+                if kn == "partial" and kernel.args:
+                    kernel = kernel.args[0]
+            if isinstance(kernel, ast.Lambda):
+                self._diag(
+                    "kernel-tracer-closure", kernel,
+                    "lambda passed to pallas_call: kernel bodies must be "
+                    "module-level functions (a local lambda closes over "
+                    "the tracing scope)")
+                continue
+            if isinstance(kernel, ast.Name) and kernel.id in local_defs:
+                kdef = local_defs[kernel.id]
+                free = _free_names(kdef)
+                if free:
+                    self._diag(
+                        "kernel-tracer-closure", kdef,
+                        f"pallas kernel {kdef.name!r} is a local def "
+                        f"with free variables {sorted(free)!r}: it may "
+                        "close over traced arrays (Mosaic lowering "
+                        "breaks — ops/pallas_kernels.py:31-33); make "
+                        "it module-level and bind statics via "
+                        "functools.partial")
+
+
+def _free_names(fn: ast.FunctionDef) -> Set[str]:
+    """Names read in ``fn`` that are neither params, locals, globals the
+    module defines, builtins, nor common module aliases."""
+    import builtins
+
+    bound: Set[str] = {a.arg for a in (
+        fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs)}
+    if fn.args.vararg:
+        bound.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        bound.add(fn.args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for el in ast.walk(t):
+                    if isinstance(el, ast.Name):
+                        bound.add(el.id)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            if isinstance(node.target, ast.Name):
+                bound.add(node.target.id)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            for el in ast.walk(node.target):
+                if isinstance(el, ast.Name):
+                    bound.add(el.id)
+        elif isinstance(node, ast.comprehension):
+            for el in ast.walk(node.target):
+                if isinstance(el, ast.Name):
+                    bound.add(el.id)
+    free: Set[str] = set()
+    module_aliases = {"jnp", "jax", "np", "pl", "lax", "functools",
+                      "pltpu", "math", "partial"}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            n = node.id
+            if n in bound or n in module_aliases or \
+                    hasattr(builtins, n) or n.isupper():
+                continue  # uppercase = module constant convention
+            free.add(n)
+    return free
+
+
+def lint_source(source: str, filename: str = "<memory>"
+                ) -> Tuple[List[Diagnostic], int]:
+    """Lint one source string; returns (diagnostics, n_suppressed)."""
+    linter = Linter(source, filename)
+    diags = linter.run()
+    return diags, linter.n_suppressed

@@ -1,0 +1,182 @@
+"""Canonical-shape registry of the jitted entry points fcheck audits.
+
+One place that knows how to build *deterministic, small* inputs for every
+jitted surface the engine exposes (ops/consensus_ops.py, ops/dense_adj.py,
+ops/segment.py, ops/pallas_kernels.py, models/*, engine.py) — the jaxpr
+audit (analysis/jaxpr_audit.py) traces each with ``jax.make_jaxpr`` and
+the analyzer's CI gate keeps the whole surface traceable.
+
+The canonical graph is structural, not random: a ring over N nodes plus
+deterministic chords.  ``make_jaxpr`` only needs shapes/dtypes, but
+deterministic *values* keep d_cap/d_hyb/hub_cap derivation (which reads
+the degree histogram on the host) stable across runs, so the audited
+lowerings never flap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional
+
+import numpy as np
+
+N_NODES = 48
+N_P = 4
+
+
+def canonical_edges(n: int = N_NODES) -> np.ndarray:
+    """Ring + two deterministic chord families; simple, connected."""
+    ring = np.stack([np.arange(n), (np.arange(n) + 1) % n], axis=1)
+    chords3 = np.stack([np.arange(0, n, 3), (np.arange(0, n, 3) + 7) % n],
+                       axis=1)
+    chords5 = np.stack([np.arange(0, n, 5), (np.arange(0, n, 5) + 13) % n],
+                       axis=1)
+    return np.concatenate([ring, chords3, chords5], axis=0).astype(np.int64)
+
+
+def canonical_slab():
+    from fastconsensus_tpu.graph import pack_edges
+
+    return pack_edges(canonical_edges(), N_NODES)
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    """``trace()`` returns the ClosedJaxpr of the op at canonical shapes."""
+
+    name: str
+    trace: Callable
+
+
+def _keys(n_p: int = N_P):
+    import jax
+
+    from fastconsensus_tpu.utils import prng
+
+    return prng.partition_keys(jax.random.key(0), n_p)
+
+
+def entry_points() -> List[EntryPoint]:
+    """Build the registry.  Imports live inside so ``--no-jaxpr`` lint
+    runs never pay a jax import."""
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.engine import consensus_round, consensus_tail
+    from fastconsensus_tpu.models.registry import available, get_detector
+    from fastconsensus_tpu.ops import consensus_ops as cops
+    from fastconsensus_tpu.ops import dense_adj as da
+    from fastconsensus_tpu.ops import pallas_kernels as pk
+    from fastconsensus_tpu.ops import segment as seg
+
+    slab = canonical_slab()
+    n = slab.n_nodes
+    cap = slab.capacity
+    key = jax.random.key(1)
+    labels = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32) % 7, (N_P, n))
+    labels1 = jnp.arange(n, dtype=jnp.int32) % 7
+    e2 = 2 * cap
+    # run-shaped operands for the segment ops
+    node = jnp.arange(e2, dtype=jnp.int32) % n
+    lab = jnp.arange(e2, dtype=jnp.int32) % 9
+    val = jnp.ones((e2,), jnp.float32)
+    ok = jnp.arange(e2) % 3 != 0
+    k_cand = 16
+    cu = jnp.arange(k_cand, dtype=jnp.int32) % n
+    cv = (jnp.arange(k_cand, dtype=jnp.int32) * 5 + 1) % n
+    cw = jnp.ones((k_cand,), jnp.float32)
+    cok = jnp.arange(k_cand) % 2 == 0
+    adj = None  # built lazily below (host-side argsort at trace time)
+
+    def mk(fn, *args, **kwargs) -> Callable:
+        return lambda: jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+
+    eps: List[EntryPoint] = [
+        EntryPoint("ops.comembership_counts",
+                   mk(cops.comembership_counts, labels, slab.src,
+                      slab.dst)),
+        EntryPoint("ops.update_weights",
+                   mk(lambda s, c: cops.update_weights(s, c, N_P), slab,
+                      jnp.ones((cap,), jnp.float32))),
+        EntryPoint("ops.threshold_weights",
+                   mk(lambda s: cops.threshold_weights(s, 0.2, N_P),
+                      slab)),
+        EntryPoint("ops.convergence_stats",
+                   mk(lambda s: cops.convergence_stats(s, N_P, 0.02),
+                      slab)),
+        EntryPoint("ops.build_csr", mk(cops.build_csr, slab)),
+        # per-trace subkeys via fold_in — the same single-tree discipline
+        # the analyzer's key-reuse rule enforces on the engine
+        EntryPoint("ops.sample_wedges",
+                   mk(lambda k, s: cops.sample_wedges(
+                       k, cops.build_csr(s), n, 32),
+                      jax.random.fold_in(key, 1), slab)),
+        EntryPoint("ops.sample_wedges_scatter",
+                   mk(lambda k, s: cops.sample_wedges_scatter(k, s, 32),
+                      jax.random.fold_in(key, 2), slab)),
+        EntryPoint("ops.insert_edges",
+                   mk(cops.insert_edges, slab, cu, cv, cw, cok)),
+        EntryPoint("ops.insert_edges_hash",
+                   mk(cops.insert_edges_hash, slab, cu, cv, cw, cok)),
+        EntryPoint("ops.singleton_candidates",
+                   mk(cops.singleton_candidates, slab, slab)),
+        EntryPoint("ops.node_label_runs",
+                   mk(lambda *a: seg.node_label_runs(*a, n_nodes=n),
+                      node, lab, val, ok)),
+        EntryPoint("ops.hash_totals",
+                   mk(lambda nd, lb, vl, vd: seg.lookup_hash_totals(
+                       seg.build_hash_totals(nd, lb, vl, vd, 1 << 12),
+                       nd, lb), node, lab, val, ok)),
+        EntryPoint("ops.scatter_argmax_label",
+                   mk(lambda *a: seg.scatter_argmax_label(*a, n_nodes=n),
+                      node, val, lab, ok)),
+        EntryPoint("ops.argmax_label_per_node",
+                   mk(lambda *a: seg.argmax_label_per_node(*a, n_nodes=n),
+                      node, val, lab, ok)),
+        EntryPoint("ops.compact_labels",
+                   mk(lambda l: seg.compact_labels(l, n), labels1)),
+        EntryPoint("ops.build_dense_adjacency",
+                   mk(da.build_dense_adjacency, slab)),
+        EntryPoint("ops.pallas_row_totals",
+                   # interpret=True: audit the CPU-lowerable program (the
+                   # TPU lowering is exercised by the kernels' own tests)
+                   mk(lambda l, w: pk.row_totals(l, w, interpret=True),
+                      jnp.zeros((16, 8), jnp.int32),
+                      jnp.ones((16, 8), jnp.float32))),
+        EntryPoint("engine.consensus_tail",
+                   mk(lambda s, lb, k: consensus_tail(
+                       s, lb, k, N_P, 0.2, 0.02, 32), slab, labels,
+                      jax.random.fold_in(key, 3))),
+    ]
+    if slab.d_cap > 0:
+        adj = da.build_dense_adjacency(slab)
+        eps.append(EntryPoint(
+            "ops.row_label_totals",
+            mk(lambda a, l: da.row_label_totals(a, l, use_pallas=False),
+               adj, labels1)))
+    if slab.d_hyb > 0 and slab.hub_cap > 0:
+        eps.append(EntryPoint("ops.build_hybrid", mk(da.build_hybrid,
+                                                     slab)))
+
+    for i, alg in enumerate(("louvain", "leiden", "lpm")):
+        try:
+            det = get_detector(alg)
+        except (NotImplementedError, ValueError):
+            continue
+        eps.append(EntryPoint(
+            f"models.{alg}", mk(det, slab, _keys())))
+        eps.append(EntryPoint(
+            f"engine.consensus_round[{alg}]",
+            mk(lambda s, k, d=det: consensus_round(
+                s, k, detect=d, n_p=N_P, tau=0.2, delta=0.02,
+                n_closure=32), slab, jax.random.fold_in(key, 100 + i))))
+    # native cnm/infomap go through pure_callback (host C++) — they are
+    # deliberately NOT device programs, so they are not audited here;
+    # available() still decides whether their registry entries resolve.
+    assert available()  # registry import sanity
+    return eps
+
+
+def entry_point_names() -> List[str]:
+    return [ep.name for ep in entry_points()]

@@ -1,0 +1,143 @@
+"""Layer 2 of fcheck: trace registered jitted entry points and audit the
+jaxprs.
+
+The AST lint (layer 1) sees the source; this layer sees what JAX will
+actually *stage*.  Every registered entry point
+(analysis/entrypoints.py) is traced with canonical small shapes via
+``jax.make_jaxpr`` — which alone catches tracer leaks, shape bugs and
+signature drift before any device is touched — and the resulting jaxpr
+(recursively, through pjit/scan/while/cond sub-jaxprs) is walked for
+primitives that must never appear in this codebase's device programs:
+
+* ``convert_element_type``/avals producing **float64/complex128** — TPUs
+  have no f64; with jax's x64 mode off the cast silently downcasts, with
+  it on it doubles memory and leaves the fast path (graph.py's slabs are
+  strictly f32/i32/bool);
+* ``device_put`` **inside a traced computation** — a host transfer
+  staged into the device program (the host touches the graph exactly
+  twice per run, graph.py module docstring);
+* **oversized gathers** — a single gather materializing more elements
+  than ``gather_threshold`` (default 2^26 ~ 256 MB of f32): the
+  symptom of an accidentally dense N^2 indexing pattern escaping a
+  size-gated path (louvain.MATMUL_MAX_N exists precisely to gate those).
+
+It also records a primitive histogram per entry point (scatters, sorts,
+whiles, ...) in the JSON report — drift in those counts is an early
+smell of a lowering change even when nothing is outright forbidden.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from fastconsensus_tpu.analysis.diagnostics import Diagnostic
+
+# Primitive families worth summarizing per entry point (observability;
+# not errors by themselves).
+_SUMMARY_PRIMS = (
+    "gather", "scatter", "scatter-add", "scatter-max", "scatter-min",
+    "sort", "while", "cond", "scan", "dot_general", "custom_vjp_call",
+    "pjit", "psum", "all_gather", "convert_element_type",
+)
+
+_BAD_DTYPES = ("float64", "complex128")
+
+
+def _iter_eqns(jaxpr) -> Iterable:
+    """All equations of a (Closed)Jaxpr, recursing into sub-jaxprs."""
+    import jax.core as core  # noqa: F401  (jaxpr types live here)
+
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in inner.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from _iter_eqns(sub)
+
+
+def _sub_jaxprs(eqn) -> Iterable:
+    for v in eqn.params.values():
+        if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for el in v:
+                if hasattr(el, "eqns") or hasattr(el, "jaxpr"):
+                    yield el
+
+
+def audit_jaxpr(closed_jaxpr, name: str,
+                gather_threshold: int = 1 << 26
+                ) -> Tuple[List[Diagnostic], Dict[str, int]]:
+    """Walk a traced jaxpr; returns (diagnostics, primitive histogram)."""
+    diags: List[Diagnostic] = []
+    hist: Dict[str, int] = {}
+    for eqn in _iter_eqns(closed_jaxpr):
+        prim = eqn.primitive.name
+        if prim in _SUMMARY_PRIMS:
+            hist[prim] = hist.get(prim, 0) + 1
+        if prim == "convert_element_type":
+            new = str(eqn.params.get("new_dtype", ""))
+            if new in _BAD_DTYPES:
+                diags.append(Diagnostic(
+                    rule="jaxpr-f64", file=name,
+                    message=f"convert_element_type to {new} staged into "
+                            f"{name}: TPU paths are f32/i32 only "
+                            f"(silently downcast with x64 off, 2x memory "
+                            f"with it on)"))
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            dt = str(getattr(aval, "dtype", ""))
+            if dt in _BAD_DTYPES:
+                diags.append(Diagnostic(
+                    rule="jaxpr-f64", file=name,
+                    message=f"{prim} produces {dt} inside {name}"))
+                break
+        if prim == "device_put":
+            diags.append(Diagnostic(
+                rule="jaxpr-device-put", file=name,
+                message=f"device_put staged inside {name}: a host "
+                        f"transfer in the device program (the slab "
+                        f"crosses the boundary once per run — graph.py)"))
+        if prim == "gather":
+            out = eqn.outvars[0].aval if eqn.outvars else None
+            size = 1
+            for d in getattr(out, "shape", ()):
+                size *= int(d)
+            if size > gather_threshold:
+                diags.append(Diagnostic(
+                    rule="jaxpr-gather-size", file=name,
+                    message=f"gather in {name} materializes {size} "
+                            f"elements (> {gather_threshold}): an "
+                            f"ungated dense indexing pattern "
+                            f"(louvain.MATMUL_MAX_N gates the N^2 "
+                            f"paths for a reason)"))
+    return diags, hist
+
+
+def audit_entry_points(names: Optional[List[str]] = None,
+                       gather_threshold: int = 1 << 26
+                       ) -> Tuple[List[Diagnostic], Dict[str, Dict[str, int]]]:
+    """Trace + audit every registered entry point (or the named subset).
+
+    A failure to trace at all is itself a diagnostic (``trace-error``):
+    the canonical shapes are the contract the jitted surface must keep.
+    """
+    from fastconsensus_tpu.analysis import entrypoints as eps
+
+    diags: List[Diagnostic] = []
+    summary: Dict[str, Dict[str, int]] = {}
+    for ep in eps.entry_points():
+        if names and ep.name not in names:
+            continue
+        try:
+            closed = ep.trace()
+        except Exception as e:  # noqa: BLE001 — any trace failure is news
+            diags.append(Diagnostic(
+                rule="trace-error", file=ep.name,
+                message=f"entry point failed to trace with canonical "
+                        f"shapes: {type(e).__name__}: {e}"))
+            continue
+        d, hist = audit_jaxpr(closed, ep.name,
+                              gather_threshold=gather_threshold)
+        diags.extend(d)
+        summary[ep.name] = hist
+    return diags, summary

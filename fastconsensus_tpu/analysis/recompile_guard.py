@@ -1,0 +1,107 @@
+"""Layer 3 of fcheck: a runtime guard against silent retracing.
+
+The engine's whole performance story rests on compiling each round shape
+ONCE and re-running it (engine.py:_jitted_round — jit caches key on the
+function object; a fresh wrapper per round cost a measured ~18 s/run
+through the TPU tunnel).  Nothing in the type system enforces that: an
+innocent refactor that rebuilds a partial per call, or hashes an
+unstable static arg, recompiles every round and no output changes — only
+the wall clock.
+
+:class:`CompileGuard` counts XLA backend compilations via jax's
+monitoring events (``/jax/core/compile/backend_compile_duration`` — one
+firing per executable actually built; cache hits, including persistent
+compile-cache hits, do not fire).  Use it as a context manager around a
+region that must not compile more than N times:
+
+    with CompileGuard(max_compiles=12) as g:
+        run_consensus(...)
+    # or g.count for reporting
+
+The tier-1 regression test (tests/test_analysis.py) runs a 2-round
+small-graph consensus under the guard and additionally asserts a second
+identical run compiles ZERO times — executable reuse across runs is the
+lru-cache contract the engine documents.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+
+class RecompileError(AssertionError):
+    """Raised when a guarded region exceeds its compile budget."""
+
+
+class CompileGuard:
+    """Count backend compiles in a region; optionally bound them.
+
+    Thread-safe counting (XLA may compile from worker threads); guards
+    may nest — each counts independently.  ``events`` records the raw
+    monitoring event names seen, for debugging a budget breach.
+    """
+
+    _COMPILE_EVENTS = (
+        "/jax/core/compile/backend_compile_duration",
+    )
+
+    def __init__(self, max_compiles: Optional[int] = None) -> None:
+        self.max_compiles = max_compiles
+        self.count = 0
+        self.events: List[str] = []
+        self._lock = threading.Lock()
+        self._registered = False
+        self._active = False
+
+    # -- listener ---------------------------------------------------
+
+    def _on_event(self, name: str, duration: float, **kwargs) -> None:
+        # _active gates counting even if the listener itself could not be
+        # unregistered (see _unregister): jax holds the bound method, so
+        # only a flag on the instance can make it inert
+        if not self._active or name not in self._COMPILE_EVENTS:
+            return
+        with self._lock:
+            self.count += 1
+            self.events.append(name)
+
+    def __enter__(self) -> "CompileGuard":
+        import jax.monitoring
+
+        self._active = True
+        jax.monitoring.register_event_duration_secs_listener(
+            self._on_event)
+        self._registered = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self._unregister()
+        if exc_type is None and self.max_compiles is not None and \
+                self.count > self.max_compiles:
+            raise RecompileError(
+                f"guarded region compiled {self.count} executables "
+                f"(budget {self.max_compiles}): something is retracing "
+                f"per call — check for fresh jit wrappers or unstable "
+                f"static args (engine.py:_jitted_round notes)")
+        return False
+
+    def _unregister(self) -> None:
+        if not self._registered:
+            return
+        self._registered = False
+        self._active = False  # inert even if the unregister below fails
+        try:
+            from jax._src import monitoring as _mon
+
+            _mon._unregister_event_duration_listener_by_callback(
+                self._on_event)
+        except Exception:
+            # private API moved: the listener stays in jax's list (a
+            # one-entry leak per guard) but _active keeps it a no-op
+            pass
+
+
+def assert_max_compiles(n: int) -> CompileGuard:
+    """``with assert_max_compiles(12): ...`` — sugar over CompileGuard."""
+    return CompileGuard(max_compiles=n)

@@ -137,6 +137,7 @@ def cpu_consensus(edges: np.ndarray,
     final = []
     for _ in range(n_p):
         labels = _detect_labels(graph, algorithm, rng.randrange(2**31))
+        # fcheck: ok=sync-in-loop (pure-host numpy oracle; no device arrays)
         final.append(np.array([labels.get(i, 0) for i in range(n_nodes)],
                               dtype=np.int64))
     return final, rounds

@@ -726,12 +726,16 @@ def run_consensus(slab: GraphSlab,
             t0 = time.perf_counter()
             noop = budget_noop if budget_noop is not None \
                 else (-1, -1, -1)
+            # fcheck: ok=key-reuse (run key + traced round index; per-round
+            # keys derive in-block exactly as the unfused path derives them)
             slab, done, buf, new_labels = block_fn(
                 slab, key, labels0, jnp.int32(r), jnp.int32(end_round - r),
                 jnp.bool_(align_now(r)),
                 policy.PolicyState(*(jnp.int32(v) for v in pstate)),
                 jnp.bool_(config.auto_grow), jnp.asarray(noop, jnp.int32))
             done = int(done)
+            # fcheck: ok=sync-in-loop (ONE bulk stats readback per block —
+            # the readback the block fusion exists to amortize)
             buf = jax.device_get(buf)
             dt = time.perf_counter() - t0
             first_call = "block" not in seen_execs
@@ -804,6 +808,7 @@ def run_consensus(slab: GraphSlab,
                     config.n_p, config.tau, config.delta, n_closure,
                     mesh, sampler, config.closure_tau)(
                     slab, labels, k_closure)
+                # fcheck: ok=sync-in-loop (one bulk stats tuple per round)
                 stats = jax.device_get(stats)
                 while config.auto_grow and int(stats.n_dropped) > 0:
                     # capacity only matters after detection: replay just
@@ -812,10 +817,14 @@ def run_consensus(slab: GraphSlab,
                     # the round's dominant cost at exactly the scale
                     # split-phase exists for)
                     grow_and_replay(pre_slab, int(stats.n_dropped))
+                    # fcheck: ok=key-reuse (deliberate: the grown replay
+                    # must reuse the round key bit-for-bit — grow_and_replay
+                    # determinism contract)
                     slab, stats = _jitted_tail(
                         config.n_p, config.tau, config.delta, n_closure,
                         mesh, sampler, config.closure_tau)(
                         slab, labels, k_closure)
+                    # fcheck: ok=sync-in-loop (bulk stats of the replay)
                     stats = jax.device_get(stats)
                 if warm:
                     cur_labels = labels
@@ -844,6 +853,7 @@ def run_consensus(slab: GraphSlab,
                 # per-field scalar readbacks each pay the full device
                 # round-trip latency, which through the TPU tunnel dwarfs
                 # the round's compute (measured).
+                # fcheck: ok=sync-in-loop (that one bulk transfer)
                 stats = jax.device_get(stats)
                 dt = time.perf_counter() - t0
                 # The round-0 cold detector and the warm variant are
@@ -873,6 +883,8 @@ def run_consensus(slab: GraphSlab,
 
                 ckpt.save_checkpoint(
                     checkpoint_path, slab, rounds,
+                    # fcheck: ok=sync-in-loop (once-per-checkpoint
+                    # persistence; the readback IS the feature)
                     np.asarray(jax.random.key_data(key)), history,
                     extra={"algorithm": config.algorithm, "n_p": config.n_p,
                            "tau": config.tau, "delta": config.delta,
@@ -883,7 +895,8 @@ def run_consensus(slab: GraphSlab,
                            "closure_tau": config.closure_tau,
                            "member_seconds": measured_member_s,
                            "converged": converged},
-                    labels=(np.asarray(cur_labels) if warm else None))
+                    labels=(np.asarray(cur_labels)  # fcheck: ok=sync-in-loop
+                            if warm else None))
             if converged:
                 break
 

@@ -47,19 +47,37 @@ def _build() -> Optional[ctypes.CDLL]:
     global _build_error
     os.makedirs(_BUILD_DIR, exist_ok=True)
     so_path = os.path.join(_BUILD_DIR, f"libfcnative-{_source_hash()}.so")
-    if not os.path.exists(so_path):
-        cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-               "-o", so_path + ".tmp"]
-        cmd += [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    lib = None
+    for attempt in (0, 1):
+        if not os.path.exists(so_path):
+            cmd = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared",
+                   "-pthread", "-o", so_path + ".tmp"]
+            cmd += [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               text=True, timeout=300)
+            except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                    FileNotFoundError) as e:
+                _build_error = getattr(e, "stderr", str(e)) or str(e)
+                return None
+            os.replace(so_path + ".tmp", so_path)
         try:
-            subprocess.run(cmd, check=True, capture_output=True, text=True,
-                           timeout=300)
-        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
-                FileNotFoundError) as e:
-            _build_error = getattr(e, "stderr", str(e)) or str(e)
-            return None
-        os.replace(so_path + ".tmp", so_path)
-    lib = ctypes.CDLL(so_path)
+            lib = ctypes.CDLL(so_path)
+            break
+        except OSError as e:
+            # A prebuilt .so shipped in the repo may have been compiled
+            # against a newer runtime than this host provides (observed:
+            # GLIBCXX_3.4.29 absent).  Drop it and rebuild from src/ once;
+            # if the freshly built library still fails to load, report
+            # unavailability instead of letting the OSError escape into
+            # callers (it used to kill pytest collection).
+            try:
+                os.remove(so_path)
+            except OSError:
+                pass
+            if attempt == 1:
+                _build_error = str(e)
+                return None
 
     i32p = ctypes.POINTER(ctypes.c_int32)
     f32p = ctypes.POINTER(ctypes.c_float)

@@ -330,14 +330,23 @@ def sharded_consensus_tail(slab: GraphSlab, labels: jax.Array,
     """
     from fastconsensus_tpu.models.louvain import _cap_hint, select_move_path
 
-    fn = jax.shard_map(
-        functools.partial(
-            _tail_local, n_p=n_p, tau=tau, delta=delta,
-            n_closure=n_closure, cap_hint=_cap_hint(slab),
-            hybrid_gate=select_move_path(slab) == "hybrid",
-            closure_tau=closure_tau),
-        mesh=mesh,
-        in_specs=(P(EDGE_AXIS), P(ENSEMBLE_AXIS, None), P()),
-        out_specs=(P(EDGE_AXIS), P()),
-        check_vma=False)
+    local = functools.partial(
+        _tail_local, n_p=n_p, tau=tau, delta=delta,
+        n_closure=n_closure, cap_hint=_cap_hint(slab),
+        hybrid_gate=select_move_path(slab) == "hybrid",
+        closure_tau=closure_tau)
+    specs = dict(mesh=mesh,
+                 in_specs=(P(EDGE_AXIS), P(ENSEMBLE_AXIS, None), P()),
+                 out_specs=(P(EDGE_AXIS), P()))
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:  # jax 0.4.x: experimental location
+        from jax.experimental.shard_map import shard_map as sm
+    # the replication-check kwarg was renamed check_rep -> check_vma
+    # independently of the move to top-level; key on the actual signature
+    import inspect
+
+    if "check_vma" in inspect.signature(sm).parameters:
+        fn = sm(local, check_vma=False, **specs)
+    else:
+        fn = sm(local, check_rep=False, **specs)
     return fn(slab, labels, k_closure)

@@ -110,7 +110,60 @@ def shard_keys(keys: jax.Array, mesh: Mesh) -> jax.Array:
         raise ValueError(
             f"n_p={keys.shape[0]} not divisible by ensemble axis "
             f"{mesh.shape[ENSEMBLE_AXIS]}; use pad_n_p")
-    return jax.device_put(keys, keys_sharding(mesh))
+    return put_keys(keys, keys_sharding(mesh))
+
+
+def _key_data_sharding(keys: jax.Array, sharding: NamedSharding
+                       ) -> NamedSharding:
+    """Extend an ensemble-axis spec over the trailing key-data dims.
+
+    Typed PRNG key arrays carry a hidden uint32 payload dim; GSPMD
+    validates specs against the RAW shape, so ``P("p")`` on keys[n_p]
+    (raw ``u32[n_p, 2]``) is a rank mismatch on jax 0.4.x (newer jax
+    extends the spec itself).  Always spelling the payload dims out
+    keeps both versions happy.
+    """
+    data = jax.random.key_data(keys)
+    spec = P(*(tuple(sharding.spec) +
+               (None,) * (data.ndim - len(sharding.spec))))
+    return NamedSharding(sharding.mesh, spec)
+
+
+def put_keys(keys: jax.Array, sharding: NamedSharding) -> jax.Array:
+    """``device_put`` for typed PRNG key arrays (see _key_data_sharding)."""
+    data = jax.device_put(jax.random.key_data(keys),
+                          _key_data_sharding(keys, sharding))
+    return jax.random.wrap_key_data(data)
+
+
+def constrain_keys(keys: jax.Array, sharding: NamedSharding) -> jax.Array:
+    """``with_sharding_constraint`` for typed PRNG key arrays (jittable)."""
+    data = jax.lax.with_sharding_constraint(
+        jax.random.key_data(keys), _key_data_sharding(keys, sharding))
+    return jax.random.wrap_key_data(data)
+
+
+def replicate_slab(slab: GraphSlab, mesh: Mesh) -> GraphSlab:
+    """Constrain every slab leaf to replicated (detection-side view).
+
+    Detection consumes the whole graph on every chip regardless — GSPMD
+    re-gathers an edge-sharded slab inside the detection's layout builds
+    (module notes above) — so pinning the gather to the jit boundary
+    costs nothing it wasn't already paying.  It also sidesteps a
+    measured XLA:CPU SPMD miscompile: a scatter/segment-sum whose
+    operand stays sharded on ``"e"`` interleaves per-device partials
+    instead of summing them (observed on jax 0.4.37's virtual CPU mesh;
+    tests/test_parallel.py bitwise parity would catch a regression).
+    The explicit shard_map tail keeps its ``P("e")`` view — shard_map
+    reshards at its own boundary.
+    """
+    import dataclasses
+
+    rep = NamedSharding(mesh, P())
+    con = lambda x: jax.lax.with_sharding_constraint(x, rep)  # noqa: E731
+    return dataclasses.replace(slab, src=con(slab.src), dst=con(slab.dst),
+                               weight=con(slab.weight),
+                               alive=con(slab.alive))
 
 
 def initialize_multihost(coordinator_address: Optional[str] = None,

@@ -117,6 +117,8 @@ def write_partition_dirs(out_dir: str,
     os.makedirs(memberships_dir, exist_ok=True)
     original_ids = np.asarray(original_ids)
     for i, labels in enumerate(partitions, start=1):
+        # fcheck: ok=sync-in-loop (each partition is a distinct array; the
+        # per-file host write IS the loop body)
         labels = np.asarray(labels)
         with open(os.path.join(out_dir, str(i)), "w") as fh:
             for comm in labels_to_communities(labels):

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# CI gate: fcheck static analysis (AST lint + jaxpr audit) must be clean,
+# then the tier-1 test suite (ROADMAP.md) must pass.
+#
+# Usage: scripts/ci_check.sh [--skip-tests]
+#   FCHECK_REPORT   where to write the JSON report
+#                   (default runs/fcheck_report.json)
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+REPORT="${FCHECK_REPORT:-runs/fcheck_report.json}"
+
+echo "== fcheck: AST lint + jaxpr audit =="
+JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis fastconsensus_tpu/ \
+    --json "$REPORT"
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcheck failed (exit $rc); report at $REPORT" >&2
+    exit $rc
+fi
+
+echo "== fcheck: violating fixtures must still be caught =="
+# guards against the analyzer silently going blind (a no-op analyzer
+# would pass the gate above forever); exit 1 means "found violations" —
+# anything else (0 = blind, 2 = crashed) fails the gate
+JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
+    tests/analysis_fixtures/ --quiet
+fixture_rc=$?
+if [ "$fixture_rc" -ne 1 ]; then
+    echo "fcheck exited $fixture_rc on the violating fixtures" \
+         "(expected 1): analyzer is broken" >&2
+    exit 1
+fi
+
+if [ "$1" = "--skip-tests" ]; then
+    echo "fcheck clean (tests skipped)"
+    exit 0
+fi
+
+echo "== tier-1 tests (ROADMAP.md) =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)"
+exit $rc

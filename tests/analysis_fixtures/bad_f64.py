@@ -1,0 +1,15 @@
+"""Fixture: float64 reaching jnp arrays -> f64-dtype."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_weights(n):
+    return jnp.zeros((n,), dtype=jnp.float64)
+
+
+def cast_up(x):
+    return x.astype(jnp.float64)
+
+
+def from_numpy(arr):
+    return jnp.asarray(arr, dtype=np.float64)

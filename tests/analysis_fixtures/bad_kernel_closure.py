@@ -1,0 +1,18 @@
+"""Fixture: Pallas kernel as local def closing over traced arrays ->
+kernel-tracer-closure (plus a module-level jnp constant)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+SENTINEL = jnp.int32(2**31 - 1)  # module-jnp-const: device array at import
+
+
+def row_sum(x, scale):
+    def kernel(x_ref, o_ref):
+        # closes over `scale` (traced!) from the enclosing trace
+        o_ref[...] = jnp.sum(x_ref[...] * scale, axis=1, keepdims=True)
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], 1), x.dtype),
+    )(x)

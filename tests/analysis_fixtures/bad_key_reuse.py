@@ -1,0 +1,15 @@
+"""Fixture: the same PRNG key consumed by two draws -> key-reuse."""
+import jax
+
+
+def two_draws(key):
+    a = jax.random.uniform(key, (4,))
+    b = jax.random.normal(key, (4,))
+    return a + b
+
+
+def loop_reuse(key, xs):
+    out = []
+    for x in xs:
+        out.append(jax.random.bernoulli(key, 0.5, (4,)) * x)
+    return out

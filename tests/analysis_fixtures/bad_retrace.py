@@ -1,0 +1,19 @@
+"""Fixture: fresh jit wrappers per call + positional statics ->
+retrace-risk / weak-static-arg."""
+import functools
+
+import jax
+
+
+def run_step(state, cfg):
+    step = jax.jit(functools.partial(_step, cfg=cfg))  # fresh every call
+    return step(state)
+
+
+def _step(state, cfg=None):
+    return state
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def positional_static(x, n):
+    return x * n

@@ -1,0 +1,26 @@
+"""Fixture: host-device syncs inside a Python loop -> sync-in-loop."""
+import jax
+import numpy as np
+
+
+def per_round_readback(step, state, n):
+    history = []
+    for _ in range(n):
+        state = step(state)
+        history.append(float(state.loss.item()))
+    return state, history
+
+
+def per_round_block(step, state, n):
+    for _ in range(n):
+        state = step(state)
+        state.block_until_ready()
+    return state
+
+
+def per_round_transfer(step, state, n):
+    outs = []
+    for _ in range(n):
+        state = step(state)
+        outs.append(np.asarray(jax.device_get(state)))
+    return outs

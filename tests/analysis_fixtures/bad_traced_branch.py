@@ -1,0 +1,14 @@
+"""Fixture: Python control flow on traced values -> traced-branch."""
+import jax.numpy as jnp
+
+
+def branchy(x):
+    if jnp.any(x > 0):
+        return x * 2
+    return x
+
+
+def loopy(x):
+    while jnp.sum(x) < 10:
+        x = x * 2
+    return x

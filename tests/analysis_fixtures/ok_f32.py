@@ -1,0 +1,12 @@
+"""Fixture: f32/i32 device arrays, host-side np.float64 is fine -> clean."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_weights(n):
+    return jnp.zeros((n,), dtype=jnp.float32)
+
+
+def host_accumulator(xs):
+    # host-side numpy f64 is allowed (e.g. exact NMI accumulation)
+    return np.zeros((len(xs),), dtype=np.float64)

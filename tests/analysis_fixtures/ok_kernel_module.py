@@ -1,0 +1,19 @@
+"""Fixture: module-level kernel, statics via functools.partial -> clean."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+SENTINEL = 2**31 - 1  # a Python int, not a device array
+
+
+def _row_sum_kernel(x_ref, o_ref, *, scale: float):
+    o_ref[...] = jnp.sum(x_ref[...] * scale, axis=1, keepdims=True)
+
+
+def row_sum(x, scale: float):
+    return pl.pallas_call(
+        functools.partial(_row_sum_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((x.shape[0], 1), x.dtype),
+    )(x)

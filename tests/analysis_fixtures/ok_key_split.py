@@ -1,0 +1,24 @@
+"""Fixture: properly split/folded keys -> clean."""
+import jax
+
+
+def two_draws(key):
+    k_a, k_b = jax.random.split(key)
+    a = jax.random.uniform(k_a, (4,))
+    b = jax.random.normal(k_b, (4,))
+    return a + b
+
+
+def loop_fold(key, xs):
+    out = []
+    for i, x in enumerate(xs):
+        k = jax.random.fold_in(key, i)
+        out.append(jax.random.bernoulli(k, 0.5, (4,)) * x)
+    return out
+
+
+def branch_exclusive(key, flag):
+    # one consumer per execution path is fine
+    if flag:
+        return jax.random.uniform(key, (2,))
+    return jax.random.normal(key, (2,))

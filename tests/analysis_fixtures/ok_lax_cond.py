@@ -1,0 +1,15 @@
+"""Fixture: device-side control flow through lax, host predicates via
+numpy -> clean."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def branchy(x):
+    return jax.lax.cond(jnp.any(x > 0), lambda v: v * 2, lambda v: v, x)
+
+
+def host_predicate(x_host):
+    if np.any(x_host > 0):
+        return x_host * 2
+    return x_host

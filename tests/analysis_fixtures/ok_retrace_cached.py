@@ -1,0 +1,18 @@
+"""Fixture: lru-cached jit builders and named statics -> clean."""
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=32)
+def jitted_step(cfg):
+    return jax.jit(functools.partial(_step, cfg=cfg))
+
+
+def _step(state, cfg=None):
+    return state
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def named_static(x, n):
+    return x * n

@@ -1,0 +1,19 @@
+"""Fixture: readbacks batched outside the loop (or pragma'd) -> clean."""
+import jax
+import numpy as np
+
+
+def bulk_readback(step, state, n):
+    states = []
+    for _ in range(n):
+        state = step(state)
+        states.append(state)
+    return np.asarray(jax.device_get(states))
+
+
+def documented_driver(step, state, n):
+    for _ in range(n):
+        state = step(state)
+        # fcheck: ok=sync-in-loop (this loop is the host driver)
+        state = jax.device_get(state)
+    return state

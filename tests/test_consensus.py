@@ -94,7 +94,11 @@ def test_auto_grow_matches_generous_capacity():
     from fastconsensus_tpu.models.registry import get_detector
     from fastconsensus_tpu.utils.synth import planted_partition
 
-    edges, _ = planted_partition(120, 4, 0.5, 0.03, seed=4)
+    # mixed enough that the n_p=8 ensemble stays contested for several
+    # rounds, so triadic closure actually saturates the tight slab (the
+    # original 0.5/0.03 planted split converges in one round under this
+    # jax version's draws and never exercised growth)
+    edges, _ = planted_partition(120, 4, 0.25, 0.12, seed=4)
     n_e = edges.shape[0]
     det = get_detector("louvain")
     cfg = ConsensusConfig(algorithm="louvain", n_p=8, tau=0.2, delta=0.02,
@@ -185,7 +189,9 @@ def test_no_grow_reports_drops():
     from fastconsensus_tpu.models.registry import get_detector
     from fastconsensus_tpu.utils.synth import planted_partition
 
-    edges, _ = planted_partition(120, 4, 0.5, 0.03, seed=4)
+    # same contested split as test_auto_grow_matches_generous_capacity —
+    # closure must actually overflow the tight slab for drops to happen
+    edges, _ = planted_partition(120, 4, 0.25, 0.12, seed=4)
     slab = pack_edges(edges, 120, capacity=edges.shape[0] + 4)
     cfg = ConsensusConfig(algorithm="louvain", n_p=8, tau=0.2, delta=0.02,
                           max_rounds=8, seed=1, auto_grow=False)
