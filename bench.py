@@ -240,6 +240,11 @@ def main() -> int:
         logging.getLogger("jax").setLevel(logging.WARNING)
         on_round = RoundTracer().on_round
 
+    from fastconsensus_tpu.analysis import CompileGuard
+    from fastconsensus_tpu.obs import counters as obs_counters
+
+    obs_reg = obs_counters.get_registry()
+
     rtt_pre = dispatch_rtt_ms()
     # Warmup: pays all jit compiles (round step + final detection).  If the
     # warmup run auto-grows the slab, re-pack at the grown capacity and
@@ -247,29 +252,86 @@ def main() -> int:
     # post-growth phases of a NON-growing timed run (different seed) would
     # otherwise hit shapes the warmup never compiled — measured on
     # emailEu: a ~14 s remote compile landed inside the timed window and
-    # read as a 5x engine regression.
+    # read as a 5x engine regression.  The cold guard counts those
+    # warmup compiles for the artifact (ROADMAP: CompileGuard in bench).
     cap = None
-    while True:
-        slab = pack_edges(edges, n_nodes, capacity=cap)
-        warm = run_consensus(slab, detector, ccfg, key=jax.random.key(123),
-                             mesh=mesh, on_round=on_round)
-        # growth multiplies capacity by >= 1.5 (grow_and_replay); a mesh
-        # pads by < its edge-axis size — only re-warm on real growth
-        if warm.graph.capacity < slab.capacity * 5 // 4:
-            break
-        cap = warm.graph.capacity
-    # Timed run, fresh seed, same (cached) executables.
+    with CompileGuard() as g_cold:
+        while True:
+            slab = pack_edges(edges, n_nodes, capacity=cap)
+            warm = run_consensus(slab, detector, ccfg,
+                                 key=jax.random.key(123),
+                                 mesh=mesh, on_round=on_round)
+            # growth multiplies capacity by >= 1.5 (grow_and_replay); a
+            # mesh pads by < its edge-axis size — only re-warm on real
+            # growth
+            if warm.graph.capacity < slab.capacity * 5 // 4:
+                break
+            cap = warm.graph.capacity
+    # Timed run, fresh seed, same (cached) executables.  The registry is
+    # reset here so the telemetry block scopes to the timed run only; the
+    # warm guard feeds it live, so a retrace regression shows up as a
+    # counted compile in the artifact, not a mystery slowdown.
+    obs_reg.reset()
+    tracer = None
+    trace_path = os.environ.get("FCTPU_BENCH_TRACE")
+    if trace_path:
+        from fastconsensus_tpu.obs import Tracer, set_tracer
+
+        tracer = Tracer()
+        set_tracer(tracer)
     t0 = time.perf_counter()
-    result = run_consensus(slab, detector, ccfg, key=jax.random.key(0),
-                           mesh=mesh, on_round=on_round)
+    with CompileGuard(registry=obs_reg) as g_warm:
+        result = run_consensus(slab, detector, ccfg, key=jax.random.key(0),
+                               mesh=mesh, on_round=on_round)
     elapsed = time.perf_counter() - t0
+    # gauge device_mem.* into the registry BEFORE any snapshot export so
+    # a traced run's artifact carries the numbers too
+    mem_stats = obs_counters.record_device_memory()
+    if tracer is not None:
+        from fastconsensus_tpu.obs import export as obs_export
+        from fastconsensus_tpu.obs import set_tracer
+
+        set_tracer(None)
+        obs_export.write_perfetto(trace_path, tracer.events(),
+                                  obs_reg.snapshot())
+        print(f"fcobs trace written to {trace_path}", file=sys.stderr)
     rtt_post = dispatch_rtt_ms()
+    if g_warm.count > 0:
+        print(f"WARNING: the timed (warm) run compiled {g_warm.count} "
+              f"executable(s) — a retrace regression; the throughput "
+              f"number below includes compile time and understates the "
+              f"engine (see telemetry.compiles_warm)", file=sys.stderr)
 
     # normalize by the chips the mesh actually uses (3 of 8 idle when n_p
     # has no divisor reaching the device count — they do no work)
     chips_used = mesh.size if mesh is not None else max(n_chips, 1)
     value = ccfg.n_p / elapsed / chips_used
     quality = float(nmi(result.partitions[0], truth))
+    # fcobs ground truth for the timed run (ISSUE 2): compile counts,
+    # deliberate host-sync crossings, per-round / per-detect-call latency
+    # percentiles, round-stat totals, device memory where the backend
+    # reports it.  Every future perf PR diffs this block instead of
+    # guessing from the throughput scalar.
+    run_counters = obs_reg.counters()
+    telemetry = {
+        "compiles_cold": g_cold.count,
+        "compiles_warm": g_warm.count,
+        "host_syncs": {k.split(".", 1)[1]: v
+                       for k, v in sorted(run_counters.items())
+                       if k.startswith("host_sync.")},
+        "round_s": obs_reg.summary("round.seconds"),
+        "rounds_block_s": obs_reg.summary("rounds_block.seconds"),
+        "detect_call_s": obs_reg.summary("detect.call_s"),
+        "converged_frac": obs_reg.summary("round.converged_frac"),
+        "rounds_cold": run_counters.get("rounds.cold", 0),
+        "closure_edges_added": run_counters.get("closure.edges_added", 0),
+        "repair_edges_added": run_counters.get("repair.edges_added", 0),
+        "regrow_events": run_counters.get("slab.regrow_events", 0),
+        "budget_rederives": run_counters.get("budgets.rederive_events", 0),
+        "executable_setups": run_counters.get("engine.setup_executables",
+                                              0),
+        "device_memory": mem_stats,
+    }
     out = {
         "metric": "consensus_partitions_per_sec_per_chip",
         "value": round(value, 3),
@@ -293,6 +355,7 @@ def main() -> int:
         # regression; next to a degraded RTT it is the transport.
         "dispatch_rtt_ms_pre": rtt_pre,
         "dispatch_rtt_ms_post": rtt_post,
+        "telemetry": telemetry,
     }
     print(json.dumps(out))
     return 0

@@ -174,6 +174,10 @@ def entry_points() -> List[EntryPoint]:
     # native cnm/infomap go through pure_callback (host C++) — they are
     # deliberately NOT device programs, so they are not audited here;
     # available() still decides whether their registry entries resolve.
+    # The fcobs observability package (obs/) is likewise host-only by
+    # design — stdlib spans/counters/exporters with zero jittable
+    # surface — so it contributes no entry points; the AST lint still
+    # covers it (lint_paths walks the whole package tree).
     assert available()  # registry import sanity
     return eps
 

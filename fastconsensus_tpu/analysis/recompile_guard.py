@@ -40,19 +40,37 @@ class CompileGuard:
     Thread-safe counting (XLA may compile from worker threads); guards
     may nest — each counts independently.  ``events`` records the raw
     monitoring event names seen, for debugging a budget breach.
+
+    ``registry``/``counter`` fold each observed compile into an
+    fcobs-style counter registry AS IT HAPPENS (duck-typed: anything with
+    ``.inc(name)`` — canonically ``fastconsensus_tpu.obs.counters
+    .ObsRegistry``), so a traced run's compile count lands in the same
+    artifact as its spans and host-sync counts (``bench.py`` telemetry).
+    :meth:`attach` sets the same hook after construction.
     """
 
     _COMPILE_EVENTS = (
         "/jax/core/compile/backend_compile_duration",
     )
 
-    def __init__(self, max_compiles: Optional[int] = None) -> None:
+    def __init__(self, max_compiles: Optional[int] = None,
+                 registry=None, counter: str = "xla.compiles") -> None:
         self.max_compiles = max_compiles
         self.count = 0
         self.events: List[str] = []
         self._lock = threading.Lock()
         self._registered = False
         self._active = False
+        self._registry = registry
+        self._counter = counter
+
+    def attach(self, registry, counter: str = "xla.compiles"
+               ) -> "CompileGuard":
+        """Mirror every observed compile into ``registry.inc(counter)``;
+        returns self so it chains with the constructor/with-statement."""
+        self._registry = registry
+        self._counter = counter
+        return self
 
     # -- listener ---------------------------------------------------
 
@@ -65,6 +83,8 @@ class CompileGuard:
         with self._lock:
             self.count += 1
             self.events.append(name)
+        if self._registry is not None:
+            self._registry.inc(self._counter)
 
     def __enter__(self) -> "CompileGuard":
         import jax.monitoring

@@ -104,6 +104,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "killed run resumes mid-round (pair with "
                         "--checkpoint/--resume; use a fresh DIR per "
                         "configuration)")
+    p.add_argument("--trace", nargs="?", const="", default=None,
+                   metavar="PATH",
+                   help="record a runtime observability trace (fcobs): "
+                        "spans for every round / detect chunk / "
+                        "executable build plus host-sync and compile "
+                        "counters. Writes Chrome/Perfetto trace_event "
+                        "JSON to PATH (open it in ui.perfetto.dev) and a "
+                        "JSONL event log to PATH.jsonl; bare --trace "
+                        "defaults to fcobs_trace.json under --out-dir")
     p.add_argument("--trace-jsonl", type=str, default=None, metavar="PATH",
                    help="append per-round stats records to a JSONL file")
     p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
@@ -190,7 +199,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     from fastconsensus_tpu.utils.trace import RoundTracer, profiler_trace
 
     tracer = RoundTracer(jsonl_path=args.trace_jsonl)
+    obs_tracer = None
+    trace_path = None
+    if args.trace is not None:
+        # fcobs span tracing (obs/): installed for the run, exported as
+        # Perfetto + JSONL artifacts below.  Dormant (the no-op ambient
+        # tracer) unless asked for.
+        from fastconsensus_tpu.obs import Tracer, get_registry, set_tracer
+
+        # bare --trace (const ""): default filename under --out-dir; an
+        # explicit PATH — even one named fcobs_trace.json — is honored
+        # verbatim
+        trace_path = args.trace or os.path.join(args.out_dir,
+                                                "fcobs_trace.json")
+        get_registry().reset()
+        obs_tracer = Tracer()
+        set_tracer(obs_tracer)
     t0 = time.perf_counter()
+    run_ok = False
     try:
         with profiler_trace(args.profile_dir):
             result = run_consensus(slab, detector, cfg,
@@ -198,11 +224,32 @@ def main(argv: Optional[List[str]] = None) -> int:
                                    resume=args.resume,
                                    on_round=tracer.on_round,
                                    detect_cache_dir=args.detect_cache)
+        run_ok = True
     except ValueError as e:
         # checkpoint/config mismatch (incl. a changed --capacity) or a
         # stale detect cache — an operator error, not a crash
         print(f"error: {e}", file=sys.stderr)
         return 2
+    finally:
+        # Export in the finally so a FAILED run still yields its (partial)
+        # trace — the spans recorded up to the failure are exactly what
+        # the operator debugging that run needs.
+        if obs_tracer is not None:
+            from fastconsensus_tpu.obs import export as obs_export
+            from fastconsensus_tpu.obs import get_registry, set_tracer
+
+            set_tracer(None)
+            snapshot = get_registry().snapshot()
+            events = obs_tracer.events()
+            obs_export.write_perfetto(trace_path, events, snapshot)
+            obs_export.write_jsonl(trace_path + ".jsonl", events, snapshot)
+            if not args.quiet and run_ok:
+                print(obs_export.summary_table(events, snapshot),
+                      file=sys.stderr)
+            partial = "" if run_ok else " (partial: the run failed)"
+            print(f"fcobs trace written to {trace_path}{partial} (open "
+                  f"in ui.perfetto.dev); event log at {trace_path}.jsonl",
+                  file=sys.stderr)
     elapsed = time.perf_counter() - t0
 
     if not args.quiet:

@@ -37,6 +37,8 @@ import numpy as np
 from fastconsensus_tpu import policy, sizing
 from fastconsensus_tpu.graph import GraphSlab, pack_edges
 from fastconsensus_tpu.models.base import Detector
+from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs.tracer import get_tracer
 from fastconsensus_tpu.utils import prng
 from fastconsensus_tpu.utils.env import env_int
 
@@ -265,6 +267,12 @@ def run_consensus(slab: GraphSlab,
     """
     if key is None:
         key = jax.random.key(config.seed)
+    # fcobs: the ambient tracer (a no-op singleton unless the caller set
+    # one — cli.py --trace) and the always-on counter registry.  Both are
+    # host-side dict/list work; with tracing disabled the per-round cost
+    # is a handful of attribute checks (the <2% bench contract, ISSUE 2).
+    tracer = get_tracer()
+    obs_reg = obs_counters.get_registry()
     n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
     if config.closure_sampler not in ("auto", "csr", "scatter"):
         raise ValueError(
@@ -404,7 +412,14 @@ def run_consensus(slab: GraphSlab,
     def setup_executables() -> None:
         """(Re-)derive call sizing and jitted step functions from the
         current slab.  Rerun after auto-growth — capacity is part of the
-        compiled shapes, so growth costs one recompile here."""
+        compiled shapes, so growth costs one recompile here.  Span- and
+        counter-wrapped (fcobs): every recompile-bearing rebuild is
+        visible in the trace instead of reading as a mystery stall."""
+        with tracer.span("setup_executables"):
+            obs_reg.inc("engine.setup_executables")
+            _setup_executables()
+
+    def _setup_executables() -> None:
         nonlocal members, cache_fp, split_phase, fused_block
         nonlocal block_fn, seen_execs, first_setup
         # Sized AFTER checkpoint resume: the loaded slab's d_cap can differ
@@ -602,6 +617,7 @@ def run_consensus(slab: GraphSlab,
 
         deg = np.asarray(jax.device_get(slab.degrees())).astype(np.int64)
         n_alive = int(np.asarray(jax.device_get(slab.num_alive())))
+        obs_counters.host_sync("budget_histogram", 2)
         new_d_cap = derive_dense_sizing(deg, slab.n_nodes)
         new_hyb, new_hub = derive_hybrid_sizing(deg, slab.n_nodes, n_alive)
         # agg_cap == 0 means compaction is off for this run (a resumed
@@ -635,6 +651,7 @@ def run_consensus(slab: GraphSlab,
             new_hub, slab.agg_cap, new_agg)
         slab = dataclasses.replace(slab, d_cap=new_d_cap, d_hyb=new_hyb,
                                    hub_cap=new_hub, agg_cap=new_agg)
+        obs_reg.inc("budgets.rederive_events")
         setup_executables()
 
     def grow_and_replay(pre_slab: GraphSlab, dropped: int) -> None:
@@ -646,18 +663,20 @@ def run_consensus(slab: GraphSlab,
         nonlocal slab
         from fastconsensus_tpu.graph import grow_slab
 
-        new_cap = pre_slab.capacity + max(2 * dropped,
-                                          pre_slab.capacity // 2)
-        _logger.warning(
-            "edge slab saturated (%d survivors dropped); growing capacity "
-            "%d -> %d and replaying the round", dropped, pre_slab.capacity,
-            new_cap)
-        slab = grow_slab(pre_slab, new_cap)
-        if mesh is not None:
-            from fastconsensus_tpu.parallel import sharding as shard
+        with tracer.span("grow_and_replay", dropped=dropped):
+            obs_reg.inc("slab.regrow_events")
+            new_cap = pre_slab.capacity + max(2 * dropped,
+                                              pre_slab.capacity // 2)
+            _logger.warning(
+                "edge slab saturated (%d survivors dropped); growing "
+                "capacity %d -> %d and replaying the round", dropped,
+                pre_slab.capacity, new_cap)
+            slab = grow_slab(pre_slab, new_cap)
+            if mesh is not None:
+                from fastconsensus_tpu.parallel import sharding as shard
 
-            slab = shard.shard_slab(slab, mesh)
-        setup_executables()
+                slab = shard.shard_slab(slab, mesh)
+            setup_executables()
 
     def record(stats) -> bool:
         """Append one round's (host-side) stats; returns converged.  Also
@@ -678,6 +697,7 @@ def run_consensus(slab: GraphSlab,
             "capacity": slab.capacity,
         }
         history.append(entry)
+        obs_counters.fold_round(entry)
         pstate = policy.observe(np, pstate, np.bool_(entry["cold"]),
                                 np.int32(entry["n_unconverged"]),
                                 np.int32(entry["n_alive"]))
@@ -717,6 +737,7 @@ def run_consensus(slab: GraphSlab,
         cur_labels = sing_labels
     r = start_round
     while r < end_round:
+        t_iter = time.perf_counter()
         maybe_resize()
         maybe_regrow_budgets()
         pre_slab = slab
@@ -726,17 +747,22 @@ def run_consensus(slab: GraphSlab,
             t0 = time.perf_counter()
             noop = budget_noop if budget_noop is not None \
                 else (-1, -1, -1)
-            # fcheck: ok=key-reuse (run key + traced round index; per-round
-            # keys derive in-block exactly as the unfused path derives them)
-            slab, done, buf, new_labels = block_fn(
-                slab, key, labels0, jnp.int32(r), jnp.int32(end_round - r),
-                jnp.bool_(align_now(r)),
-                policy.PolicyState(*(jnp.int32(v) for v in pstate)),
-                jnp.bool_(config.auto_grow), jnp.asarray(noop, jnp.int32))
-            done = int(done)
-            # fcheck: ok=sync-in-loop (ONE bulk stats readback per block —
-            # the readback the block fusion exists to amortize)
-            buf = jax.device_get(buf)
+            with tracer.span("rounds_block", r0=r, block=fused_block):
+                # fcheck: ok=key-reuse (run key + traced round index;
+                # per-round keys derive in-block exactly as the unfused
+                # path derives them)
+                slab, done, buf, new_labels = block_fn(
+                    slab, key, labels0, jnp.int32(r),
+                    jnp.int32(end_round - r), jnp.bool_(align_now(r)),
+                    policy.PolicyState(*(jnp.int32(v) for v in pstate)),
+                    jnp.bool_(config.auto_grow),
+                    jnp.asarray(noop, jnp.int32))
+                # fcheck: ok=sync-in-loop (ONE bulk readback per block —
+                # round count + stats in a single transfer; the readback
+                # the block fusion exists to amortize)
+                done, buf = jax.device_get((done, buf))
+                done = int(done)
+            obs_counters.host_sync("block_stats")
             dt = time.perf_counter() - t0
             first_call = "block" not in seen_execs
             seen_execs.add("block")
@@ -765,6 +791,16 @@ def run_consensus(slab: GraphSlab,
                 if record(jax.tree.map(lambda b: b[i], buf)):
                     break
             r += done
+            if done:
+                # per-round samples are the block average (one device
+                # call covers all `done` rounds); the unsmeared block
+                # wall goes to its own series so a single slow block —
+                # e.g. a mid-run recompile — still surfaces as an
+                # outlier in rounds_block.seconds p95/max
+                obs_reg.observe("rounds_block.seconds", dt)
+                per_round = (time.perf_counter() - t_iter) / done
+                for _ in range(done):
+                    obs_reg.observe("round.seconds", per_round)
             if converged:
                 break
         else:
@@ -784,14 +820,15 @@ def run_consensus(slab: GraphSlab,
                     # members still differ through their warm labels)
                     keys = keys[jnp.zeros((config.n_p,), jnp.int32)]
                 timings: List[float] = []
-                labels = _detect_chunked(
-                    det_r, slab, keys, members,
-                    cache_dir=detect_cache_dir,
-                    cache_tag=f"{cache_fp}_r{r}",
-                    init_labels=(sing_labels if is_cold else cur_labels)
-                    if warm else None,
-                    ensemble_sharding=ensemble_sharding,
-                    timings=timings)
+                with tracer.span("detect", r=r, mode=mode):
+                    labels = _detect_chunked(
+                        det_r, slab, keys, members,
+                        cache_dir=detect_cache_dir,
+                        cache_tag=f"{cache_fp}_r{r}",
+                        init_labels=(sing_labels if is_cold else cur_labels)
+                        if warm else None,
+                        ensemble_sharding=ensemble_sharding,
+                        timings=timings)
                 if timings:
                     # feed the measured on-device rate back into call
                     # sizing (replaces the static estimate after round 0;
@@ -804,12 +841,15 @@ def run_consensus(slab: GraphSlab,
                     measured_in_process = True
                     record_rate(measured_member_s, cold=not warm or is_cold,
                                 call_s=measured_member_s * members)
-                slab, stats = _jitted_tail(
-                    config.n_p, config.tau, config.delta, n_closure,
-                    mesh, sampler, config.closure_tau)(
-                    slab, labels, k_closure)
-                # fcheck: ok=sync-in-loop (one bulk stats tuple per round)
-                stats = jax.device_get(stats)
+                with tracer.span("tail", r=r):
+                    slab, stats = _jitted_tail(
+                        config.n_p, config.tau, config.delta, n_closure,
+                        mesh, sampler, config.closure_tau)(
+                        slab, labels, k_closure)
+                    # fcheck: ok=sync-in-loop (one bulk stats tuple per
+                    # round)
+                    stats = jax.device_get(stats)
+                obs_counters.host_sync("round_stats")
                 while config.auto_grow and int(stats.n_dropped) > 0:
                     # capacity only matters after detection: replay just
                     # the tail with the in-hand labels (labels are
@@ -826,6 +866,7 @@ def run_consensus(slab: GraphSlab,
                         slab, labels, k_closure)
                     # fcheck: ok=sync-in-loop (bulk stats of the replay)
                     stats = jax.device_get(stats)
+                    obs_counters.host_sync("round_stats")
                 if warm:
                     cur_labels = labels
             else:
@@ -838,23 +879,27 @@ def run_consensus(slab: GraphSlab,
                     config.delta, n_closure, ensemble_sharding, sampler,
                     config.closure_tau)
                 t0 = time.perf_counter()
-                if warm:
-                    # align passed traced: flipping it mid-run reuses the
-                    # same executable (no endgame recompile); cold refresh
-                    # rounds take singleton init — round 0's executable
-                    slab_new, new_labels, stats = round_fn(
-                        slab, k,
-                        init_labels=sing_labels if is_cold else cur_labels,
-                        align=jnp.bool_(align_now(r) and not is_cold))
-                else:
-                    slab_new, new_labels, stats = round_fn(slab, k)
-                slab = slab_new
-                # One bulk device->host transfer for the whole stats tuple:
-                # per-field scalar readbacks each pay the full device
-                # round-trip latency, which through the TPU tunnel dwarfs
-                # the round's compute (measured).
-                # fcheck: ok=sync-in-loop (that one bulk transfer)
-                stats = jax.device_get(stats)
+                with tracer.span("round", r=r, mode=mode):
+                    if warm:
+                        # align passed traced: flipping it mid-run reuses
+                        # the same executable (no endgame recompile); cold
+                        # refresh rounds take singleton init — round 0's
+                        # executable
+                        slab_new, new_labels, stats = round_fn(
+                            slab, k,
+                            init_labels=sing_labels if is_cold
+                            else cur_labels,
+                            align=jnp.bool_(align_now(r) and not is_cold))
+                    else:
+                        slab_new, new_labels, stats = round_fn(slab, k)
+                    slab = slab_new
+                    # One bulk device->host transfer for the whole stats
+                    # tuple: per-field scalar readbacks each pay the full
+                    # device round-trip latency, which through the TPU
+                    # tunnel dwarfs the round's compute (measured).
+                    # fcheck: ok=sync-in-loop (that one bulk transfer)
+                    stats = jax.device_get(stats)
+                obs_counters.host_sync("round_stats")
                 dt = time.perf_counter() - t0
                 # The round-0 cold detector and the warm variant are
                 # DIFFERENT executables: each one's first call pays its own
@@ -877,26 +922,35 @@ def run_consensus(slab: GraphSlab,
             r += 1
             stats = stats._replace(cold=np.bool_(is_cold))
             record(stats)
+            obs_reg.observe("round.seconds", time.perf_counter() - t_iter)
             if checkpoint_path is not None and \
                     (rounds % checkpoint_every == 0 or converged):
                 from fastconsensus_tpu.utils import checkpoint as ckpt
 
-                ckpt.save_checkpoint(
-                    checkpoint_path, slab, rounds,
-                    # fcheck: ok=sync-in-loop (once-per-checkpoint
-                    # persistence; the readback IS the feature)
-                    np.asarray(jax.random.key_data(key)), history,
-                    extra={"algorithm": config.algorithm, "n_p": config.n_p,
-                           "tau": config.tau, "delta": config.delta,
-                           "gamma": config.gamma,
-                           "warm_start": config.warm_start,
-                           "align_frac": config.align_frac,
-                           "closure_sampler": sampler,
-                           "closure_tau": config.closure_tau,
-                           "member_seconds": measured_member_s,
-                           "converged": converged},
-                    labels=(np.asarray(cur_labels)  # fcheck: ok=sync-in-loop
-                            if warm else None))
+                with tracer.span("checkpoint", round=rounds):
+                    # two readbacks when warm (key data + labels), one
+                    # when cold — same per-readback convention as
+                    # budget_histogram
+                    obs_counters.host_sync("checkpoint", 2 if warm else 1)
+                    ckpt.save_checkpoint(
+                        checkpoint_path, slab, rounds,
+                        # fcheck: ok=sync-in-loop (once-per-checkpoint
+                        # persistence; the readback IS the feature)
+                        np.asarray(jax.random.key_data(key)), history,
+                        extra={"algorithm": config.algorithm,
+                               "n_p": config.n_p,
+                               "tau": config.tau, "delta": config.delta,
+                               "gamma": config.gamma,
+                               "warm_start": config.warm_start,
+                               "align_frac": config.align_frac,
+                               "closure_sampler": sampler,
+                               "closure_tau": config.closure_tau,
+                               "member_seconds": measured_member_s,
+                               "converged": converged},
+                        # fcheck: ok=sync-in-loop (labels persisted with
+                        # the checkpoint)
+                        labels=(np.asarray(cur_labels)
+                                if warm else None))
             if converged:
                 break
 
@@ -917,14 +971,18 @@ def run_consensus(slab: GraphSlab,
     final_detect = detect_warm if (
         warm and (cold_start_round == -1 or rounds > start_round)) \
         else detect
-    final_labels = _detect_chunked(final_detect, slab, final_keys, members,
-                                   cache_dir=detect_cache_dir,
-                                   cache_tag=f"{cache_fp}_final",
-                                   init_labels=cur_labels if warm else None,
-                                   ensemble_sharding=ensemble_sharding)
-    # Single bulk readback of the [n_p, N] label matrix (per-row transfers
-    # each pay the device round-trip; see the stats readback note above).
-    all_labels = jax.device_get(final_labels)
+    with tracer.span("final_detect"):
+        final_labels = _detect_chunked(
+            final_detect, slab, final_keys, members,
+            cache_dir=detect_cache_dir,
+            cache_tag=f"{cache_fp}_final",
+            init_labels=cur_labels if warm else None,
+            ensemble_sharding=ensemble_sharding)
+        # Single bulk readback of the [n_p, N] label matrix (per-row
+        # transfers each pay the device round-trip; see the stats
+        # readback note above).
+        all_labels = jax.device_get(final_labels)
+    obs_counters.host_sync("final_labels")
     partitions = [all_labels[i] for i in range(config.n_p)]
     return ConsensusResult(partitions=partitions, graph=slab, rounds=rounds,
                            converged=converged, history=history)

@@ -34,6 +34,8 @@ import numpy as np
 from fastconsensus_tpu import policy
 from fastconsensus_tpu.graph import GraphSlab
 from fastconsensus_tpu.models.base import Detector
+from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs.tracer import get_tracer
 from fastconsensus_tpu.ops import consensus_ops as cops
 from fastconsensus_tpu.utils import prng
 
@@ -476,6 +478,8 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
     Detector protocol docstring).
     """
     n_p = keys.shape[0]
+    tracer = get_tracer()
+    obs_reg = obs_counters.get_registry()
     jd = _jitted_detect(detect)
     if ensemble_sharding is not None:
         # detection-side replicated slab view (parallel.sharding
@@ -502,7 +506,11 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
         return jd(slab, ks) if init is None else jd(slab, ks, init)
 
     if members >= n_p:
-        return call(keys, init_labels)
+        # whole-ensemble dispatch: labels stay on device (no sync here),
+        # so the span measures dispatch/trace time only — the execute
+        # lands in the caller's round/tail span
+        with tracer.span("detect_dispatch", members=n_p):
+            return call(keys, init_labels)
     # Pad to a whole number of equal chunks: one compiled shape for every
     # call (a ragged remainder would pay a second multi-minute remote
     # compile for at most `members-1` members of work).
@@ -531,18 +539,23 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
                         f"{(members, slab.n_nodes)} int32; clean the "
                         f"cache dir")
                 parts.append(jnp.asarray(cached))
+                obs_reg.inc("detect.chunks_cached")
                 _logger.debug("detect call %d/%d: loaded from %s",
                               i + 1, n_calls, path)
                 continue
         t0 = time.perf_counter()
         sl = slice(i * members, (i + 1) * members)
-        out = call(keys[sl],
-                   None if init_labels is None else init_labels[sl])
-        # fcheck: ok=sync-in-loop (deliberate: the per-chunk barrier IS
-        # the timing measurement call sizing feeds on, and chunking IS
-        # the split-dispatch feature)
-        out.block_until_ready()
+        with tracer.span("detect_chunk", chunk=i, members=members):
+            out = call(keys[sl],
+                       None if init_labels is None else init_labels[sl])
+            # fcheck: ok=sync-in-loop (deliberate: the per-chunk barrier
+            # IS the timing measurement call sizing feeds on, and
+            # chunking IS the split-dispatch feature)
+            out.block_until_ready()
+        obs_counters.host_sync("detect_chunk")
         dt = time.perf_counter() - t0
+        obs_reg.inc("detect.chunks")
+        obs_reg.observe("detect.call_s", dt)
         _logger.debug("detect call %d/%d (%d members): %.1fs",
                       i + 1, n_calls, members, dt)
         if timings is not None and computed > 0:

@@ -1,0 +1,35 @@
+"""fcobs: the runtime observability subsystem.
+
+The TPU port's hot loop was a black box: when a bench number moved there
+was no artifact separating a retrace regression from a slow detect call
+from a host-sync stall.  fcobs is the ground-truth layer — three
+stdlib-only modules the engine is permanently instrumented with:
+
+* **obs/tracer.py** — nested host-side spans (wall + CPU time,
+  thread-safe, ~free when disabled).  ``run_consensus`` opens spans per
+  round / detect chunk / executable setup / growth replay.
+* **obs/counters.py** — always-on counter/gauge/series registry:
+  consensus round stats, deliberate host-sync crossings (every pragma'd
+  readback in the driver), XLA compiles (``analysis.CompileGuard``
+  attaches via ``registry=``), detect-call latency series, device memory.
+* **obs/export.py** — JSONL event log, Chrome/Perfetto ``trace_event``
+  JSON (open in ``ui.perfetto.dev``), plain-text summary table.
+
+Consumers: ``cli.py --trace[=PATH]`` records a run and writes the
+Perfetto + JSONL artifacts; ``bench.py`` emits a ``telemetry`` block
+(compile / host-sync counts, round + detect latency percentiles) in its
+JSON line.  See README "Observability".
+"""
+
+from fastconsensus_tpu.obs.counters import (ObsRegistry,  # noqa: F401
+                                            device_memory, fold_round,
+                                            get_registry, host_sync,
+                                            record_device_memory)
+from fastconsensus_tpu.obs.tracer import (Tracer, get_tracer,  # noqa: F401
+                                          set_tracer, traced, use_tracer)
+
+__all__ = [
+    "Tracer", "get_tracer", "set_tracer", "use_tracer", "traced",
+    "ObsRegistry", "get_registry", "host_sync", "fold_round",
+    "device_memory", "record_device_memory",
+]

@@ -1,0 +1,177 @@
+"""fcobs counters: a process-wide counter / gauge / series registry.
+
+Where spans (obs/tracer.py) answer *where the wall clock went*, the
+registry answers *how many times did X happen* — and stays always-on:
+every operation is a dict update under a lock, cheap enough for the host
+driver loop's handful-of-events-per-round rate, so counts exist even when
+span tracing is disabled (``bench.py`` builds its ``telemetry`` block
+from exactly this).
+
+Three kinds of signal:
+
+* **counters** — monotonically increasing ints (``inc``): consensus
+  rounds, deliberate host-sync crossings (:func:`host_sync` — called at
+  every ``# fcheck: ok=sync-in-loop``-pragma'd readback in engine.py /
+  consensus.py), XLA compiles (``analysis.CompileGuard`` attaches via its
+  ``registry=`` hook), closure/repair edge totals, regrow events;
+* **gauges** — last-write-wins floats (``gauge``): slab capacity, device
+  memory (:func:`record_device_memory`);
+* **series** — observed samples (``observe``) summarized on demand
+  (:meth:`ObsRegistry.summary`: count / total / mean / p50 / p95 / max):
+  per-round wall seconds, per-member detect-call latency.
+
+Scoping: the registry is process-global (one consensus run per process is
+the operating mode — CLI, bench, supervised long runs).  Callers that
+need per-run deltas ``reset()`` before the run or diff ``snapshot()``s.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional
+
+
+def percentile(sorted_values: List[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending-sorted non-empty list."""
+    if not sorted_values:
+        raise ValueError("percentile of an empty series")
+    n = len(sorted_values)
+    rank = max(1, min(n, math.ceil(q * n)))
+    return sorted_values[rank - 1]
+
+
+class ObsRegistry:
+    """Thread-safe counter/gauge/series store; see module docstring."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._gauges: Dict[str, float] = {}
+        self._series: Dict[str, List[float]] = {}
+
+    # -- writes ------------------------------------------------------
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + int(n)
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        with self._lock:
+            self._series.setdefault(name, []).append(float(value))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._series.clear()
+
+    # -- reads -------------------------------------------------------
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def gauges(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
+    def series(self, name: str) -> List[float]:
+        with self._lock:
+            return list(self._series.get(name, ()))
+
+    def summary(self, name: str) -> Optional[dict]:
+        """Summary stats of one series, or None if nothing was observed."""
+        values = self.series(name)
+        if not values:
+            return None
+        values.sort()
+        total = sum(values)
+        return {
+            "count": len(values),
+            "total": round(total, 6),
+            "mean": round(total / len(values), 6),
+            "p50": round(percentile(values, 0.50), 6),
+            "p95": round(percentile(values, 0.95), 6),
+            "max": round(values[-1], 6),
+        }
+
+    def snapshot(self) -> dict:
+        """One JSON-ready dict of everything (series as summaries)."""
+        with self._lock:
+            names = list(self._series)
+        return {
+            "counters": self.counters(),
+            "gauges": self.gauges(),
+            "series": {n: self.summary(n) for n in names},
+        }
+
+
+_REGISTRY = ObsRegistry()
+
+
+def get_registry() -> ObsRegistry:
+    """The process-global registry."""
+    return _REGISTRY
+
+
+def host_sync(tag: str, n: int = 1) -> None:
+    """Count a deliberate host-device sync crossing.
+
+    Called next to every pragma'd ``jax.device_get`` /
+    ``block_until_ready`` in the driver (engine.py / consensus.py), so a
+    bench artifact can separate "the engine started syncing per item" from
+    "the device got slower" — the distinction the round-3 transport
+    incident took a day to make by hand.
+    """
+    _REGISTRY.inc("host_sync.total", n)
+    _REGISTRY.inc(f"host_sync.{tag}", n)
+
+
+def fold_round(entry: dict) -> None:
+    """Fold one round's history entry (consensus.run_consensus.record)
+    into the registry: round counts, closure/repair/drop totals, the
+    converged-edge fraction series, and the slab-capacity gauge."""
+    _REGISTRY.inc("rounds.total")
+    if entry.get("cold"):
+        _REGISTRY.inc("rounds.cold")
+    _REGISTRY.inc("closure.edges_added", entry.get("n_closure_added", 0))
+    _REGISTRY.inc("repair.edges_added", entry.get("n_repaired", 0))
+    _REGISTRY.inc("capacity.edges_dropped", entry.get("n_dropped", 0))
+    n_alive = entry.get("n_alive", 0)
+    if n_alive:
+        frac = 1.0 - entry.get("n_unconverged", 0) / n_alive
+        _REGISTRY.observe("round.converged_frac", frac)
+    if entry.get("capacity"):
+        _REGISTRY.gauge("slab.capacity", entry["capacity"])
+
+
+def device_memory() -> Optional[dict]:
+    """Allocator stats of the first local device, where the backend
+    exposes them (TPU/GPU ``memory_stats()``; None on CPU and on any
+    plugin that does not implement the call)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — observability must never raise
+        return None
+    if not stats:
+        return None
+    return {k: int(v) for k, v in stats.items()
+            if isinstance(v, (int, float))}
+
+
+def record_device_memory(prefix: str = "device_mem") -> Optional[dict]:
+    """Gauge the headline allocator numbers (bytes in use / peak / limit)
+    into the registry; returns the raw stats dict (or None)."""
+    stats = device_memory()
+    if stats:
+        for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+            if key in stats:
+                _REGISTRY.gauge(f"{prefix}.{key}", stats[key])
+    return stats

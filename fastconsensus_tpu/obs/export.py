@@ -1,0 +1,169 @@
+"""fcobs exporters: JSONL event log, Chrome/Perfetto trace JSON, text table.
+
+Three views of one run's spans (obs/tracer.py) + counters
+(obs/counters.py):
+
+* :func:`write_jsonl` — append-friendly event log, one JSON object per
+  line (``{"kind": "span", ...}`` per span, a final ``{"kind":
+  "counters", ...}`` snapshot record).  The machine-diffable artifact for
+  regression archaeology.
+* :func:`write_perfetto` — Chrome ``trace_event`` JSON (the
+  ``{"traceEvents": [...]}`` object form) loadable directly in
+  ``ui.perfetto.dev`` or ``chrome://tracing``: complete ("X") events with
+  microsecond ``ts``/``dur``, thread tracks named after the host threads
+  that ran the spans, the counter snapshot under ``otherData``.  Events
+  are sorted by ``ts`` so the artifact is reproducible byte-for-byte for
+  a deterministic run.
+* :func:`summary_table` — the plain-text per-span-name aggregate (count /
+  total / p50 / p95 wall ms) plus counters, for terminals and bench logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from fastconsensus_tpu.obs.counters import percentile
+
+PROCESS_NAME = "fastconsensus-tpu"
+_PID = 1
+
+
+def span_stats(events: List[dict]) -> Dict[str, dict]:
+    """Per-span-name aggregates over complete ("X") events: count and
+    total/p50/p95/max wall milliseconds.  Keyed by span name, ordered by
+    descending total time."""
+    buckets: Dict[str, List[float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        buckets.setdefault(ev["name"], []).append(ev["dur"] / 1000.0)
+    out = {}
+    for name, durs in sorted(buckets.items(),
+                             key=lambda kv: -sum(kv[1])):
+        durs.sort()
+        out[name] = {
+            "count": len(durs),
+            "total_ms": round(sum(durs), 3),
+            "p50_ms": round(percentile(durs, 0.50), 3),
+            "p95_ms": round(percentile(durs, 0.95), 3),
+            "max_ms": round(durs[-1], 3),
+        }
+    return out
+
+
+def write_jsonl(path: str, events: List[dict],
+                snapshot: Optional[dict] = None) -> None:
+    """One JSON object per line: every span event, then the counter
+    snapshot (when given) as a trailing ``{"kind": "counters"}`` record."""
+    _ensure_parent(path)
+    with open(path, "w", encoding="utf-8") as fh:
+        for ev in sorted(events, key=lambda e: e["ts"]):
+            fh.write(json.dumps({"kind": "span", **ev}) + "\n")
+        if snapshot is not None:
+            fh.write(json.dumps({"kind": "counters", **snapshot}) + "\n")
+
+
+def to_perfetto(events: List[dict],
+                snapshot: Optional[dict] = None,
+                process_name: str = PROCESS_NAME) -> dict:
+    """Chrome ``trace_event`` object form of a span list (see module
+    docstring).  Host thread idents map to small stable tids (in order of
+    first appearance) with ``thread_name`` metadata, so multi-threaded
+    traces render as named tracks."""
+    tids: Dict[int, int] = {}
+    for ev in events:
+        tids.setdefault(ev.get("tid", 0), len(tids) + 1)
+    trace_events: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": _PID, "tid": 0, "ts": 0,
+        "args": {"name": process_name},
+    }]
+    for ident, tid in tids.items():
+        name = "driver" if tid == 1 else f"thread-{tid}"
+        trace_events.append({
+            "name": "thread_name", "ph": "M", "pid": _PID, "tid": tid,
+            "ts": 0, "args": {"name": name},
+        })
+    for ev in sorted(events, key=lambda e: e["ts"]):
+        args = dict(ev.get("args") or {})
+        if ev.get("cpu_us"):
+            args["cpu_us"] = ev["cpu_us"]
+        out = {
+            "name": ev["name"],
+            "cat": "fcobs",
+            "ph": ev.get("ph", "X"),
+            "ts": ev["ts"],
+            "pid": _PID,
+            "tid": tids.get(ev.get("tid", 0), 1),
+        }
+        if out["ph"] == "X":
+            out["dur"] = ev["dur"]
+        else:
+            out["s"] = "t"  # instant scope: thread
+        if args:
+            out["args"] = args
+        trace_events.append(out)
+    blob = {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+    }
+    other: dict = {"span_stats": span_stats(events)}
+    if snapshot is not None:
+        other["counters"] = snapshot
+    blob["otherData"] = other
+    return blob
+
+
+def _ensure_parent(path: str) -> None:
+    parent = os.path.dirname(os.path.abspath(path))
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+
+
+def write_perfetto(path: str, events: List[dict],
+                   snapshot: Optional[dict] = None,
+                   process_name: str = PROCESS_NAME) -> None:
+    _ensure_parent(path)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(to_perfetto(events, snapshot, process_name), fh)
+        fh.write("\n")
+
+
+def summary_table(events: List[dict],
+                  snapshot: Optional[dict] = None) -> str:
+    """Aligned plain-text summary: span aggregates, then counters."""
+    stats = span_stats(events)
+    lines = []
+    if stats:
+        name_w = max(len("span"), *(len(n) for n in stats))
+        header = (f"{'span':<{name_w}}  {'count':>6}  {'total_ms':>10}  "
+                  f"{'p50_ms':>9}  {'p95_ms':>9}  {'max_ms':>9}")
+        lines.append(header)
+        lines.append("-" * len(header))
+        for name, s in stats.items():
+            lines.append(
+                f"{name:<{name_w}}  {s['count']:>6}  {s['total_ms']:>10.3f}"
+                f"  {s['p50_ms']:>9.3f}  {s['p95_ms']:>9.3f}"
+                f"  {s['max_ms']:>9.3f}")
+    else:
+        lines.append("(no spans recorded)")
+    if snapshot:
+        counters = snapshot.get("counters") or {}
+        gauges = snapshot.get("gauges") or {}
+        if counters or gauges:
+            lines.append("")
+            lines.append("counters:")
+            for k in sorted(counters):
+                lines.append(f"  {k} = {counters[k]}")
+            for k in sorted(gauges):
+                lines.append(f"  {k} = {gauges[k]:g}")
+        series = snapshot.get("series") or {}
+        live = {k: v for k, v in series.items() if v}
+        if live:
+            lines.append("series (count / p50 / p95):")
+            for k in sorted(live):
+                s = live[k]
+                lines.append(f"  {k} = {s['count']} / {s['p50']:g} / "
+                             f"{s['p95']:g}")
+    return "\n".join(lines)

@@ -1,0 +1,211 @@
+"""fcobs spans: a low-overhead host-side span tracer for the driver loop.
+
+Spans measure the *host-visible* phases of a consensus run — rounds,
+detection chunks, executable (re)builds, growth replays, the final
+re-detection — as nested intervals with wall time (``time.perf_counter``)
+and CPU time (``time.process_time``).  Device-side kernel timing belongs
+to ``jax.profiler`` (utils/trace.py:profiler_trace); fcobs answers the
+cheaper, always-available question: where did the driver's wall clock go,
+and how often did it cross the host-device boundary (obs/counters.py).
+
+Overhead contract: **disabled is the default and costs ~nothing.**  A
+disabled tracer's :meth:`Tracer.span` is one attribute check returning a
+shared no-op context manager — no event objects, no clock reads, no lock
+traffic — so the instrumentation stays in the hot path permanently and
+``cli.py --trace`` / tests merely swap in an enabled tracer
+(:func:`set_tracer` / :func:`use_tracer`).
+
+Thread-safety: each thread keeps its own span stack (nesting and
+parenting are per-thread properties), and finished spans append to one
+shared list under a lock.  XLA may call back from worker threads; spans
+opened there interleave correctly.
+
+Finished spans are plain dicts shaped for the exporters (obs/export.py):
+``name``, ``ph`` ("X" complete / "i" instant), ``ts``/``dur`` in integer
+microseconds relative to the tracer's start, ``cpu_us``, ``tid``,
+``depth``, ``parent`` and optional ``args``.  Children close before their
+parents, so the event list is ordered by span *end*; exporters re-sort by
+``ts``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+# fcheck: ok=sync-in-loop (the tracer's whole job is deliberate host
+# clock reads — time.perf_counter/process_time on span entry and exit;
+# they touch no device values and never force a device sync)
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class _NullSpan:
+    """Shared do-nothing context manager handed out by disabled tracers."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span; records itself into the tracer on ``__exit__``."""
+
+    __slots__ = ("_tracer", "name", "args", "_t0", "_cpu0", "_parent",
+                 "_depth")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 args: Optional[Dict]) -> None:
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+
+    def __enter__(self) -> "_Span":
+        stack = self._tracer._stack()
+        self._parent = stack[-1].name if stack else None
+        self._depth = len(stack)
+        stack.append(self)
+        self._t0 = time.perf_counter()
+        self._cpu0 = time.process_time()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t1 = time.perf_counter()
+        cpu1 = time.process_time()
+        stack = self._tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        ev = {
+            "name": self.name,
+            "ph": "X",
+            "ts": int((self._t0 - self._tracer._t0) * 1e6),
+            "dur": int((t1 - self._t0) * 1e6),
+            "cpu_us": int((cpu1 - self._cpu0) * 1e6),
+            "tid": threading.get_ident(),
+            "depth": self._depth,
+            "parent": self._parent,
+        }
+        if self.args:
+            ev["args"] = self.args
+        self._tracer._record(ev)
+        return False
+
+
+class Tracer:
+    """Collects nested spans; see the module docstring for the contract."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._t0 = time.perf_counter()
+
+    # -- recording ---------------------------------------------------
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _record(self, ev: dict) -> None:
+        with self._lock:
+            self._events.append(ev)
+
+    # -- public API --------------------------------------------------
+
+    def span(self, name: str, **args):
+        """Context manager timing a named region; ``args`` become the
+        span's Perfetto args.  Returns the shared no-op span when the
+        tracer is disabled (nothing is allocated or recorded)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args) -> None:
+        """Record a zero-duration marker (Perfetto ``ph: "i"``)."""
+        if not self.enabled:
+            return
+        stack = self._stack()
+        ev = {
+            "name": name,
+            "ph": "i",
+            "ts": int((time.perf_counter() - self._t0) * 1e6),
+            "dur": 0,
+            "cpu_us": 0,
+            "tid": threading.get_ident(),
+            "depth": len(stack),
+            "parent": stack[-1].name if stack else None,
+        }
+        if args:
+            ev["args"] = args
+        self._record(ev)
+
+    def events(self) -> List[dict]:
+        """Snapshot of all finished spans (ordered by span end)."""
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+# The ambient tracer consulted by instrumented code.  Disabled by default:
+# run_consensus and the engine call get_tracer() unconditionally, and the
+# no-op path is the permanent cost of having the instrumentation at all.
+_DISABLED = Tracer(enabled=False)
+_active: Tracer = _DISABLED
+
+
+def get_tracer() -> Tracer:
+    """The ambient tracer (a disabled singleton unless one was set)."""
+    return _active
+
+
+def set_tracer(tracer: Optional[Tracer]) -> Tracer:
+    """Install ``tracer`` as the ambient tracer (None restores the
+    disabled default).  Returns the now-active tracer."""
+    global _active
+    _active = tracer if tracer is not None else _DISABLED
+    return _active
+
+
+@contextlib.contextmanager
+def use_tracer(tracer: Tracer):
+    """Scoped :func:`set_tracer`: restores the previous tracer on exit."""
+    global _active
+    prev = _active
+    _active = tracer
+    try:
+        yield tracer
+    finally:
+        _active = prev
+
+
+def traced(name: Optional[str] = None) -> Callable:
+    """Decorator form: time every call of ``fn`` as a span on the tracer
+    active *at call time*.  With tracing disabled the wrapper adds one
+    global read and one attribute check per call."""
+    def deco(fn: Callable) -> Callable:
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            tracer = _active
+            if not tracer.enabled:
+                return fn(*a, **kw)
+            with tracer.span(label):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco

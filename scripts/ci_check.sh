@@ -32,6 +32,37 @@ if [ "$fixture_rc" -ne 1 ]; then
     exit 1
 fi
 
+echo "== fcobs: traced-consensus smoke (artifacts must parse) =="
+SMOKE_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR"' EXIT
+JAX_PLATFORMS=cpu python -m fastconsensus_tpu.cli -f examples/karate_club.txt \
+    --alg lpm -np 4 -d 0.1 --max-rounds 2 --seed 1 --quiet \
+    --out-dir "$SMOKE_DIR" --trace "$SMOKE_DIR/trace.json"
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "traced consensus smoke run failed (exit $rc)" >&2
+    exit $rc
+fi
+JAX_PLATFORMS=cpu python - "$SMOKE_DIR/trace.json" <<'PYEOF'
+import json, sys
+path = sys.argv[1]
+blob = json.load(open(path))
+xs = [e for e in blob["traceEvents"] if e.get("ph") == "X"]
+assert xs, "perfetto trace recorded no spans"
+ts = [e["ts"] for e in xs]
+assert ts == sorted(ts), "perfetto ts not monotonically ordered"
+lines = [json.loads(line) for line in open(path + ".jsonl")]
+assert lines and lines[-1]["kind"] == "counters", "jsonl missing counters"
+assert lines[-1]["counters"].get("rounds.total", 0) >= 1, "no rounds counted"
+print(f"fcobs smoke ok: {len(xs)} spans, "
+      f"{lines[-1]['counters']['rounds.total']} round(s) counted")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcobs artifacts failed to parse (exit $rc)" >&2
+    exit $rc
+fi
+
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"
     exit 0

@@ -1,0 +1,296 @@
+"""fcobs observability subsystem: span tracer semantics, disabled-path
+overhead, counter folding from a real consensus run, Perfetto/JSONL
+export round-trips, CompileGuard registry attachment, and the CLI
+``--trace`` surface."""
+
+import json
+import os
+
+import pytest
+
+KARATE = os.path.join(os.path.dirname(__file__), "..", "examples",
+                      "karate_club.txt")
+
+
+@pytest.fixture()
+def registry():
+    """The process-global registry, reset around each test so counts
+    never leak across tests (or from earlier engine activity)."""
+    from fastconsensus_tpu.obs import get_registry
+
+    reg = get_registry()
+    reg.reset()
+    yield reg
+    reg.reset()
+
+
+# ---------------------------------------------------------------- tracer
+
+def test_span_nesting_ordering_and_args():
+    from fastconsensus_tpu.obs import Tracer
+
+    tr = Tracer()
+    with tr.span("outer"):
+        with tr.span("inner", k=1):
+            pass
+        with tr.span("inner2"):
+            pass
+    events = tr.events()
+    # children close before their parents
+    assert [e["name"] for e in events] == ["inner", "inner2", "outer"]
+    by = {e["name"]: e for e in events}
+    assert by["outer"]["depth"] == 0 and by["outer"]["parent"] is None
+    assert by["inner"]["depth"] == 1 and by["inner"]["parent"] == "outer"
+    assert by["inner"]["args"] == {"k": 1}
+    # interval containment: inner spans lie inside outer's [ts, ts+dur]
+    for name in ("inner", "inner2"):
+        assert by[name]["ts"] >= by["outer"]["ts"]
+        assert (by[name]["ts"] + by[name]["dur"]
+                <= by["outer"]["ts"] + by["outer"]["dur"])
+    # sibling ordering
+    assert by["inner2"]["ts"] >= by["inner"]["ts"] + by["inner"]["dur"]
+    assert all(e["dur"] >= 0 and e["cpu_us"] >= 0 for e in events)
+
+
+def test_disabled_tracer_allocates_and_records_nothing():
+    from fastconsensus_tpu.obs import Tracer, get_tracer
+    from fastconsensus_tpu.obs.tracer import _NULL_SPAN
+
+    tr = Tracer(enabled=False)
+    s1 = tr.span("a", x=1)
+    s2 = tr.span("b")
+    # the disabled path hands out ONE shared no-op span — no per-call
+    # allocation, no clock reads
+    assert s1 is s2 is _NULL_SPAN
+    with s1:
+        tr.instant("marker")
+    assert tr.events() == []
+    # the ambient default is the disabled singleton
+    assert not get_tracer().enabled
+
+
+def test_traced_decorator_uses_the_ambient_tracer():
+    from fastconsensus_tpu.obs import Tracer, traced, use_tracer
+
+    calls = []
+
+    @traced("work")
+    def fn(x):
+        calls.append(x)
+        return x + 1
+
+    assert fn(1) == 2  # ambient tracer disabled: plain call
+    tr = Tracer()
+    with use_tracer(tr):
+        assert fn(2) == 3
+    assert fn(3) == 4  # restored on exit
+    assert [e["name"] for e in tr.events()] == ["work"]
+    assert calls == [1, 2, 3]
+
+
+def test_tracer_is_thread_safe():
+    import threading
+
+    from fastconsensus_tpu.obs import Tracer
+
+    tr = Tracer()
+
+    def worker(i):
+        with tr.span(f"w{i}"):
+            with tr.span(f"w{i}.child"):
+                pass
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    events = tr.events()
+    assert len(events) == 16
+    # per-thread nesting survived the interleaving
+    for i in range(8):
+        by = {e["name"]: e for e in events
+              if e["name"].startswith(f"w{i}")}
+        assert by[f"w{i}.child"]["parent"] == f"w{i}"
+
+
+# -------------------------------------------------------------- registry
+
+def test_registry_counters_gauges_series(registry):
+    registry.inc("a")
+    registry.inc("a", 2)
+    registry.gauge("g", 3.5)
+    for v in range(1, 101):
+        registry.observe("lat", v / 100.0)
+    assert registry.counters()["a"] == 3
+    s = registry.summary("lat")
+    assert s["count"] == 100
+    assert s["p50"] == pytest.approx(0.5)
+    assert s["p95"] == pytest.approx(0.95)
+    assert s["max"] == pytest.approx(1.0)
+    assert registry.summary("missing") is None
+    snap = registry.snapshot()
+    assert snap["gauges"]["g"] == 3.5
+    json.dumps(snap)  # JSON-ready by construction
+
+
+def test_compile_guard_attaches_to_registry(registry):
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.analysis import CompileGuard
+
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    with CompileGuard(registry=registry, counter="xla.compiles") as g:
+        f(jnp.ones((9,)))
+    assert g.count >= 1
+    assert registry.counters().get("xla.compiles", 0) == g.count
+    # the post-construction attach() hook feeds the same counter
+    with CompileGuard().attach(registry, counter="xla.compiles2") as g2:
+        f(jnp.ones((11,)))  # new shape: compiles again
+    assert g2.count >= 1
+    assert registry.counters().get("xla.compiles2", 0) == g2.count
+
+
+# --------------------------------------------- consensus-run integration
+
+def test_counter_folding_from_karate_run(karate_slab, registry):
+    """A real 2-round karate run populates spans AND counters: round
+    totals match the result history, every deliberate host sync is
+    counted, and the per-round latency series has one sample per round."""
+    from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
+    from fastconsensus_tpu.obs import Tracer, use_tracer
+    from fastconsensus_tpu.models.registry import get_detector
+
+    cfg = ConsensusConfig(algorithm="louvain", n_p=6, tau=0.2, delta=0.02,
+                          max_rounds=2, seed=0)
+    tr = Tracer()
+    with use_tracer(tr):
+        res = run_consensus(karate_slab, get_detector("louvain"), cfg)
+    counters = registry.counters()
+    assert counters["rounds.total"] == res.rounds == len(res.history)
+    assert counters["rounds.cold"] >= 1  # round 0 detects cold
+    assert counters["closure.edges_added"] == \
+        sum(h["n_closure_added"] for h in res.history)
+    assert counters["host_sync.total"] >= 2  # stats readback(s) + labels
+    assert counters["host_sync.final_labels"] == 1
+    assert counters["engine.setup_executables"] >= 1
+    assert len(registry.series("round.seconds")) == res.rounds
+    names = {e["name"] for e in tr.events()}
+    assert "setup_executables" in names and "final_detect" in names
+    # rounds run either fused (small graphs) or one call per round
+    assert names & {"round", "rounds_block"}
+    # converged-edge fraction is a valid fraction series
+    assert all(0.0 <= v <= 1.0
+               for v in registry.series("round.converged_frac"))
+
+
+def test_disabled_tracing_records_no_spans_but_counters_flow(
+        karate_slab, registry):
+    """With the ambient tracer disabled (the default), a run must record
+    zero span events — the hot path's no-op contract — while the always-on
+    registry still counts rounds."""
+    from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
+    from fastconsensus_tpu.obs import get_tracer
+    from fastconsensus_tpu.models.registry import get_detector
+
+    tracer = get_tracer()
+    assert not tracer.enabled
+    before = len(tracer.events())
+    cfg = ConsensusConfig(algorithm="louvain", n_p=6, tau=0.2, delta=0.02,
+                          max_rounds=2, seed=0)
+    res = run_consensus(karate_slab, get_detector("louvain"), cfg)
+    assert len(tracer.events()) == before == 0
+    assert registry.counters()["rounds.total"] == res.rounds
+
+
+# -------------------------------------------------------------- exports
+
+def _sample_events():
+    from fastconsensus_tpu.obs import Tracer
+
+    tr = Tracer()
+    with tr.span("run"):
+        for i in range(3):
+            with tr.span("round", r=i):
+                pass
+        tr.instant("grown", dropped=7)
+    return tr.events()
+
+
+def test_perfetto_export_roundtrips_with_ordered_ts(tmp_path, registry):
+    from fastconsensus_tpu.obs import export as obs_export
+
+    registry.inc("rounds.total", 3)
+    path = str(tmp_path / "trace.json")
+    obs_export.write_perfetto(path, _sample_events(),
+                              registry.snapshot())
+    blob = json.load(open(path))
+    assert blob["displayTimeUnit"] == "ms"
+    xs = [e for e in blob["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 4
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+    for e in xs:
+        assert set(e) >= {"name", "cat", "ph", "ts", "dur", "pid", "tid"}
+    # instants, metadata, and the counter snapshot ride along
+    assert any(e.get("ph") == "i" for e in blob["traceEvents"])
+    assert any(e.get("ph") == "M" for e in blob["traceEvents"])
+    assert blob["otherData"]["counters"]["counters"]["rounds.total"] == 3
+    assert blob["otherData"]["span_stats"]["round"]["count"] == 3
+
+
+def test_jsonl_export_roundtrips(tmp_path, registry):
+    from fastconsensus_tpu.obs import export as obs_export
+
+    registry.inc("x", 5)
+    path = str(tmp_path / "events.jsonl")
+    obs_export.write_jsonl(path, _sample_events(), registry.snapshot())
+    lines = [json.loads(line) for line in open(path)]
+    spans = [ln for ln in lines if ln["kind"] == "span"]
+    assert len(spans) == 5  # 4 X + 1 instant
+    assert [s["ts"] for s in spans] == sorted(s["ts"] for s in spans)
+    assert lines[-1]["kind"] == "counters"
+    assert lines[-1]["counters"]["x"] == 5
+
+
+def test_summary_table_formats(registry):
+    from fastconsensus_tpu.obs import export as obs_export
+
+    registry.inc("rounds.total", 2)
+    text = obs_export.summary_table(_sample_events(),
+                                    registry.snapshot())
+    assert "span" in text and "round" in text
+    assert "rounds.total = 2" in text
+    assert obs_export.summary_table([]) == "(no spans recorded)"
+
+
+# ------------------------------------------------------------------ CLI
+
+def test_cli_trace_writes_perfetto_and_jsonl(tmp_path, registry):
+    from fastconsensus_tpu.cli import main
+
+    trace = tmp_path / "run_trace.json"
+    rc = main(["-f", KARATE, "--alg", "lpm", "-np", "4", "-d", "0.1",
+               "--max-rounds", "2", "--seed", "1",
+               "--out-dir", str(tmp_path), "--quiet",
+               "--trace", str(trace)])
+    assert rc == 0
+    assert trace.is_file() and trace.stat().st_size > 0
+    blob = json.load(open(trace))
+    xs = [e for e in blob["traceEvents"] if e.get("ph") == "X"]
+    assert xs, "trace recorded no spans"
+    assert [e["ts"] for e in xs] == sorted(e["ts"] for e in xs)
+    sidecar = str(trace) + ".jsonl"
+    assert os.path.getsize(sidecar) > 0
+    lines = [json.loads(line) for line in open(sidecar)]
+    assert lines[-1]["kind"] == "counters"
+    assert lines[-1]["counters"]["rounds.total"] >= 1
+    # the ambient tracer was restored to the disabled default
+    from fastconsensus_tpu.obs import get_tracer
+
+    assert not get_tracer().enabled
