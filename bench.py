@@ -17,6 +17,16 @@ Environment knobs:
                        planted100k   (the five BASELINE.md eval configs)
   FCTPU_BENCH_FORCE_BASELINE=1   re-measure the CPU baseline
   FCTPU_BENCH_VERBOSE=1          per-round + per-detect-call tracing
+  FCTPU_BENCH_TRACE=PATH         write an fcobs Perfetto trace of the
+                                 timed run to PATH
+  FCTPU_BENCH_PROFILE_DIR=DIR    jax.profiler trace of the timed run;
+                                 with FCTPU_BENCH_TRACE, the Perfetto
+                                 artifact becomes the merged host+device
+                                 timeline (obs/device.py)
+
+History: every JSON line lands in the regression tracker's scope —
+``scripts/bench_report.py`` ingests BENCH_*.json / runs/bench_*.json and
+gates CI on throughput/NMI/warm-compile regressions (obs/history.py).
 
 Output: ONE JSON line
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
@@ -233,12 +243,12 @@ def main() -> int:
     if os.environ.get("FCTPU_BENCH_VERBOSE"):
         import logging
 
-        from fastconsensus_tpu.utils.trace import RoundTracer
+        from fastconsensus_tpu.obs.roundlog import RoundLog
 
         logging.basicConfig(level=logging.DEBUG, stream=sys.stderr,
                             format="%(message)s")
         logging.getLogger("jax").setLevel(logging.WARNING)
-        on_round = RoundTracer().on_round
+        on_round = RoundLog().on_round
 
     from fastconsensus_tpu.analysis import CompileGuard
     from fastconsensus_tpu.obs import counters as obs_counters
@@ -274,15 +284,25 @@ def main() -> int:
     obs_reg.reset()
     tracer = None
     trace_path = os.environ.get("FCTPU_BENCH_TRACE")
+    # FCTPU_BENCH_PROFILE_DIR: wrap the timed run in a jax.profiler
+    # trace; with FCTPU_BENCH_TRACE too, spans annotate the profiler
+    # timeline and the Perfetto artifact is the merged host+device view
+    # (the cli.py --trace --profile-dir combination, bench-shaped)
+    profile_dir = os.environ.get("FCTPU_BENCH_PROFILE_DIR")
+    from fastconsensus_tpu.obs.device import ProfilerSession
+
     if trace_path:
         from fastconsensus_tpu.obs import Tracer, set_tracer
 
-        tracer = Tracer()
+        tracer = Tracer(annotate=profile_dir is not None)
         set_tracer(tracer)
     t0 = time.perf_counter()
-    with CompileGuard(registry=obs_reg) as g_warm:
-        result = run_consensus(slab, detector, ccfg, key=jax.random.key(0),
-                               mesh=mesh, on_round=on_round)
+    prof = ProfilerSession(profile_dir)
+    with prof:
+        with CompileGuard(registry=obs_reg) as g_warm:
+            result = run_consensus(slab, detector, ccfg,
+                                   key=jax.random.key(0),
+                                   mesh=mesh, on_round=on_round)
     elapsed = time.perf_counter() - t0
     # gauge device_mem.* into the registry BEFORE any snapshot export so
     # a traced run's artifact carries the numbers too
@@ -290,10 +310,14 @@ def main() -> int:
     if tracer is not None:
         from fastconsensus_tpu.obs import export as obs_export
         from fastconsensus_tpu.obs import set_tracer
+        from fastconsensus_tpu.obs.device import finalize_merge
 
         set_tracer(None)
-        obs_export.write_perfetto(trace_path, tracer.events(),
-                                  obs_reg.snapshot())
+        blob = obs_export.to_perfetto(tracer.events(), obs_reg.snapshot())
+        if profile_dir:
+            # same merge-or-stamp degradation policy as cli.py --trace
+            blob, _ = finalize_merge(blob, prof, tracer.t0)
+        obs_export.write_perfetto_blob(trace_path, blob)
         print(f"fcobs trace written to {trace_path}", file=sys.stderr)
     rtt_post = dispatch_rtt_ms()
     if g_warm.count > 0:
@@ -334,6 +358,7 @@ def main() -> int:
     }
     out = {
         "metric": "consensus_partitions_per_sec_per_chip",
+        "config": name,  # history grouping key (obs/history.py)
         "value": round(value, 3),
         "unit": f"partitions/s/chip (lfr={name}, alg={cfg['alg']}, "
                 f"n_p={ccfg.n_p})",

@@ -44,7 +44,7 @@ def explicit_pipeline():
     from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
     from fastconsensus_tpu.graph import pack_edges
     from fastconsensus_tpu.models.registry import available, get_detector
-    from fastconsensus_tpu.utils.trace import RoundTracer
+    from fastconsensus_tpu.obs.roundlog import RoundLog
 
     edges, n = load_karate()
     slab = pack_edges(edges, n_nodes=n)
@@ -52,7 +52,7 @@ def explicit_pipeline():
 
     cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.8, delta=0.02,
                           seed=1)
-    tracer = RoundTracer()
+    tracer = RoundLog()
     with tempfile.TemporaryDirectory() as tmp:
         res = run_consensus(slab, get_detector("lpm"), cfg,
                             checkpoint_path=os.path.join(tmp, "state.npz"),

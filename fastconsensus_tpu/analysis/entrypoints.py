@@ -177,7 +177,13 @@ def entry_points() -> List[EntryPoint]:
     # The fcobs observability package (obs/) is likewise host-only by
     # design — stdlib spans/counters/exporters with zero jittable
     # surface — so it contributes no entry points; the AST lint still
-    # covers it (lint_paths walks the whole package tree).
+    # covers it (lint_paths walks the whole package tree).  That stays
+    # true for the PR-3 additions: obs/history.py (pure-stdlib bench
+    # archaeology), obs/roundlog.py, and obs/device.py — the last one
+    # *talks to* jax.profiler (TraceAnnotation wrappers, trace-file
+    # merging) but builds no jittable programs, so there is nothing for
+    # the jaxpr audit to trace; its host clock reads carry the same
+    # sync-in-loop pragma discipline as the tracer.
     assert available()  # registry import sanity
     return eps
 

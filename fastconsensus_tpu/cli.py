@@ -112,11 +112,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "counters. Writes Chrome/Perfetto trace_event "
                         "JSON to PATH (open it in ui.perfetto.dev) and a "
                         "JSONL event log to PATH.jsonl; bare --trace "
-                        "defaults to fcobs_trace.json under --out-dir")
+                        "defaults to fcobs_trace.json under --out-dir. "
+                        "Combine with --profile-dir for one merged "
+                        "host+device timeline")
     p.add_argument("--trace-jsonl", type=str, default=None, metavar="PATH",
                    help="append per-round stats records to a JSONL file")
     p.add_argument("--profile-dir", type=str, default=None, metavar="DIR",
-                   help="write a jax.profiler device trace to DIR")
+                   help="write a jax.profiler device trace to DIR; with "
+                        "--trace, fcobs spans are mirrored into it as "
+                        "profiler annotations (per-round steps) and the "
+                        "profiler timeline is merged into the --trace "
+                        "Perfetto artifact (host-only on CPU)")
     return p
 
 
@@ -196,15 +202,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                           warm_start=not args.cold_detect,
                           closure_sampler=args.closure_sampler,
                           closure_tau=args.closure_tau, **extra_cfg)
-    from fastconsensus_tpu.utils.trace import RoundTracer, profiler_trace
+    from fastconsensus_tpu.obs.device import ProfilerSession
+    from fastconsensus_tpu.obs.roundlog import RoundLog
 
-    tracer = RoundTracer(jsonl_path=args.trace_jsonl)
+    round_log = RoundLog(jsonl_path=args.trace_jsonl)
+    on_round = round_log.on_round
     obs_tracer = None
+    streamer = None
     trace_path = None
     if args.trace is not None:
         # fcobs span tracing (obs/): installed for the run, exported as
         # Perfetto + JSONL artifacts below.  Dormant (the no-op ambient
-        # tracer) unless asked for.
+        # tracer) unless asked for.  With --profile-dir the tracer also
+        # ANNOTATES: every span mirrors into the jax.profiler timeline
+        # (TraceAnnotation / per-round StepTraceAnnotation), and the
+        # profiler's trace merges into the Perfetto artifact below —
+        # one timeline with aligned host and device tracks.
         from fastconsensus_tpu.obs import Tracer, get_registry, set_tracer
 
         # bare --trace (const ""): default filename under --out-dir; an
@@ -213,16 +226,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         trace_path = args.trace or os.path.join(args.out_dir,
                                                 "fcobs_trace.json")
         get_registry().reset()
-        obs_tracer = Tracer()
+        obs_tracer = Tracer(annotate=args.profile_dir is not None)
         set_tracer(obs_tracer)
+        # the .jsonl sidecar STREAMS (one flush per round): a
+        # stall-killed (SIGKILL) process leaves everything but its
+        # in-flight round on disk, so supervised restarts still chain a
+        # killed attempt's telemetry (supervise --rotate)
+        from fastconsensus_tpu.obs.export import JsonlStreamer
+
+        streamer = JsonlStreamer(trace_path + ".jsonl", obs_tracer)
+
+        def on_round(entry):
+            round_log.on_round(entry)
+            streamer.flush()
     t0 = time.perf_counter()
     run_ok = False
+    prof = ProfilerSession(args.profile_dir)
     try:
-        with profiler_trace(args.profile_dir):
+        with prof:
             result = run_consensus(slab, detector, cfg,
                                    checkpoint_path=args.checkpoint,
                                    resume=args.resume,
-                                   on_round=tracer.on_round,
+                                   on_round=on_round,
                                    detect_cache_dir=args.detect_cache)
         run_ok = True
     except ValueError as e:
@@ -241,15 +266,29 @@ def main(argv: Optional[List[str]] = None) -> int:
             set_tracer(None)
             snapshot = get_registry().snapshot()
             events = obs_tracer.events()
-            obs_export.write_perfetto(trace_path, events, snapshot)
-            obs_export.write_jsonl(trace_path + ".jsonl", events, snapshot)
+            blob = obs_export.to_perfetto(events, snapshot)
+            merged_note = ""
+            if args.profile_dir:
+                # one merge-or-stamp policy shared with bench.py: graft
+                # the profiler's trace (stopped above) onto the fcobs
+                # timeline, or record WHY there was nothing to graft —
+                # the artifact always carries device_attribution
+                from fastconsensus_tpu.obs.device import finalize_merge
+
+                blob, info = finalize_merge(blob, prof, obs_tracer.t0)
+                if info.get("merged"):
+                    merged_note = (" [merged host+device]"
+                                   if info.get("device_track")
+                                   else " [merged, host-only profile]")
+            obs_export.write_perfetto_blob(trace_path, blob)
+            streamer.close(snapshot)
             if not args.quiet and run_ok:
                 print(obs_export.summary_table(events, snapshot),
                       file=sys.stderr)
             partial = "" if run_ok else " (partial: the run failed)"
-            print(f"fcobs trace written to {trace_path}{partial} (open "
-                  f"in ui.perfetto.dev); event log at {trace_path}.jsonl",
-                  file=sys.stderr)
+            print(f"fcobs trace written to {trace_path}{partial}"
+                  f"{merged_note} (open in ui.perfetto.dev); event log "
+                  f"at {trace_path}.jsonl", file=sys.stderr)
     elapsed = time.perf_counter() - t0
 
     if not args.quiet:

@@ -143,7 +143,12 @@ def _resume_from_checkpoint(checkpoint_path: str, slab: GraphSlab,
     """Load and validate a checkpoint for ``run_consensus``.
 
     Returns ``(slab, start_round, key, prior_history, cur_labels,
-    measured_member_s, resumed_converged, sampler)``.  Rejects checkpoints
+    measured_member_s, resumed_converged, sampler, saved_counters)``
+    where ``saved_counters`` is the checkpoint's fcobs counter snapshot
+    ({} when absent) — already delta-restored into the live registry for
+    display, and handed back so later checkpoints can persist
+    ``saved + this-process increments`` (run-scoped, immune to counts an
+    unrelated earlier run left in the process registry).  Rejects checkpoints
     from a different run configuration: resuming a tau/n_p/algorithm/graph
     mismatch would silently mix semantics (weights are co-membership
     counts out of the *saved* n_p).
@@ -176,6 +181,7 @@ def _resume_from_checkpoint(checkpoint_path: str, slab: GraphSlab,
                 "checkpoint predates closure_sampler; continuing with "
                 "the scatter engine it was written with")
             sampler = "scatter"
+    saved_counters = extra.pop("_telemetry", None) or {}
     cur_labels = None
     if warm and extra.get("_labels") is not None:
         cur_labels = jnp.asarray(extra["_labels"])
@@ -221,9 +227,26 @@ def _resume_from_checkpoint(checkpoint_path: str, slab: GraphSlab,
         raise ValueError(
             f"checkpoint {checkpoint_path} was written by a different "
             f"run configuration: {mismatch} (saved, requested)")
+    if saved_counters:
+        # Telemetry continuity: raise the process-global counters to at
+        # least the dead process's checkpointed totals (delta restore —
+        # an in-process re-resume that already holds the counts adds
+        # nothing), so summaries/artifacts of the resumed run report
+        # cumulative counts across the whole run, not this process.
+        # Restored only AFTER every validation above: a REJECTED resume
+        # must not leak the dead run's counts into the live registry.
+        applied = obs_counters.get_registry().restore_counters(
+            saved_counters)
+        if applied:
+            _logger.info(
+                "restored %d fcobs counter(s) from checkpoint telemetry "
+                "(cumulative across restarts; rounds.total now %d)",
+                len(applied),
+                obs_counters.get_registry().counters().get(
+                    "rounds.total", 0))
     resumed_converged = bool(extra.get("converged", False))
     return (slab, start_round, key, prior_history, cur_labels,
-            measured_member_s, resumed_converged, sampler)
+            measured_member_s, resumed_converged, sampler, saved_counters)
 
 
 class ConsensusResult(NamedTuple):
@@ -336,16 +359,28 @@ def run_consensus(slab: GraphSlab,
     if resume and checkpoint_path is not None and \
             os.path.exists(checkpoint_path):
         (slab, start_round, key, prior_history, cur_labels,
-         measured_member_s, resumed_converged, sampler) = \
+         measured_member_s, resumed_converged, sampler, saved_counters) = \
             _resume_from_checkpoint(checkpoint_path, slab, config, warm,
                                     sampler, key)
     else:
         start_round = 0
         prior_history = []
         resumed_converged = False
+        saved_counters = {}
         # weights <- 1.0 at loop start (fc:135-136); input weights are
         # ignored, matching the reference (documented in utils/io.py).
         slab = slab.with_weights(jnp.where(slab.alive, 1.0, 0.0))
+    # Run-scoped telemetry base (taken AFTER any resume restore): a
+    # checkpoint persists saved_counters + the increments since here, so
+    # counts an unrelated earlier run left in the process-global registry
+    # never leak into this run's checkpoint metadata.
+    obs_base = obs_reg.counters()
+
+    def run_telemetry() -> dict:
+        out = dict(saved_counters)
+        for k, v in obs_reg.counters_since(obs_base).items():
+            out[k] = out.get(k, 0) + v
+        return out
 
     ensemble_sharding = None
     if mesh is not None:
@@ -747,7 +782,9 @@ def run_consensus(slab: GraphSlab,
             t0 = time.perf_counter()
             noop = budget_noop if budget_noop is not None \
                 else (-1, -1, -1)
-            with tracer.span("rounds_block", r0=r, block=fused_block):
+            # step_span: under --profile-dir the block is one profiler
+            # step (StepTraceAnnotation) keyed by its first round
+            with tracer.step_span("rounds_block", r, block=fused_block):
                 # fcheck: ok=key-reuse (run key + traced round index;
                 # per-round keys derive in-block exactly as the unfused
                 # path derives them)
@@ -820,53 +857,62 @@ def run_consensus(slab: GraphSlab,
                     # members still differ through their warm labels)
                     keys = keys[jnp.zeros((config.n_p,), jnp.int32)]
                 timings: List[float] = []
-                with tracer.span("detect", r=r, mode=mode):
-                    labels = _detect_chunked(
-                        det_r, slab, keys, members,
-                        cache_dir=detect_cache_dir,
-                        cache_tag=f"{cache_fp}_r{r}",
-                        init_labels=(sing_labels if is_cold else cur_labels)
-                        if warm else None,
-                        ensemble_sharding=ensemble_sharding,
-                        timings=timings)
-                if timings:
-                    # feed the measured on-device rate back into call
-                    # sizing (replaces the static estimate after round 0;
-                    # persisted in checkpoints below and per-backend via
-                    # record_rate).  Applied by maybe_resize at the TOP of
-                    # the next iteration, never here: a mid-round re-size
-                    # may turn split-phase off entirely and null the
-                    # executables this round still needs (ADVICE round 2).
-                    measured_member_s = float(np.median(timings))
-                    measured_in_process = True
-                    record_rate(measured_member_s, cold=not warm or is_cold,
-                                call_s=measured_member_s * members)
-                with tracer.span("tail", r=r):
-                    slab, stats = _jitted_tail(
-                        config.n_p, config.tau, config.delta, n_closure,
-                        mesh, sampler, config.closure_tau)(
-                        slab, labels, k_closure)
-                    # fcheck: ok=sync-in-loop (one bulk stats tuple per
-                    # round)
-                    stats = jax.device_get(stats)
-                obs_counters.host_sync("round_stats")
-                while config.auto_grow and int(stats.n_dropped) > 0:
-                    # capacity only matters after detection: replay just
-                    # the tail with the in-hand labels (labels are
-                    # capacity-independent; redetecting here would double
-                    # the round's dominant cost at exactly the scale
-                    # split-phase exists for)
-                    grow_and_replay(pre_slab, int(stats.n_dropped))
-                    # fcheck: ok=key-reuse (deliberate: the grown replay
-                    # must reuse the round key bit-for-bit — grow_and_replay
-                    # determinism contract)
-                    slab, stats = _jitted_tail(
-                        config.n_p, config.tau, config.delta, n_closure,
-                        mesh, sampler, config.closure_tau)(
-                        slab, labels, k_closure)
-                    # fcheck: ok=sync-in-loop (bulk stats of the replay)
-                    stats = jax.device_get(stats)
+                # step_span: the whole split round (detect chunks + tail
+                # + any capacity replay) is one profiler step, so device
+                # ops group per consensus round under --profile-dir
+                with tracer.step_span("round", r, mode=mode, split=True):
+                    with tracer.span("detect", r=r, mode=mode):
+                        labels = _detect_chunked(
+                            det_r, slab, keys, members,
+                            cache_dir=detect_cache_dir,
+                            cache_tag=f"{cache_fp}_r{r}",
+                            init_labels=(sing_labels if is_cold
+                                         else cur_labels)
+                            if warm else None,
+                            ensemble_sharding=ensemble_sharding,
+                            timings=timings)
+                    if timings:
+                        # feed the measured on-device rate back into call
+                        # sizing (replaces the static estimate after
+                        # round 0; persisted in checkpoints below and
+                        # per-backend via record_rate).  Applied by
+                        # maybe_resize at the TOP of the next iteration,
+                        # never here: a mid-round re-size may turn
+                        # split-phase off entirely and null the
+                        # executables this round still needs (ADVICE
+                        # round 2).
+                        measured_member_s = float(np.median(timings))
+                        measured_in_process = True
+                        record_rate(measured_member_s,
+                                    cold=not warm or is_cold,
+                                    call_s=measured_member_s * members)
+                    with tracer.span("tail", r=r):
+                        slab, stats = _jitted_tail(
+                            config.n_p, config.tau, config.delta,
+                            n_closure, mesh, sampler, config.closure_tau)(
+                            slab, labels, k_closure)
+                        # fcheck: ok=sync-in-loop (one bulk stats tuple
+                        # per round)
+                        stats = jax.device_get(stats)
                     obs_counters.host_sync("round_stats")
+                    while config.auto_grow and int(stats.n_dropped) > 0:
+                        # capacity only matters after detection: replay
+                        # just the tail with the in-hand labels (labels
+                        # are capacity-independent; redetecting here
+                        # would double the round's dominant cost at
+                        # exactly the scale split-phase exists for)
+                        grow_and_replay(pre_slab, int(stats.n_dropped))
+                        # fcheck: ok=key-reuse (deliberate: the grown
+                        # replay must reuse the round key bit-for-bit —
+                        # grow_and_replay determinism contract)
+                        slab, stats = _jitted_tail(
+                            config.n_p, config.tau, config.delta,
+                            n_closure, mesh, sampler, config.closure_tau)(
+                            slab, labels, k_closure)
+                        # fcheck: ok=sync-in-loop (bulk stats of the
+                        # replay)
+                        stats = jax.device_get(stats)
+                        obs_counters.host_sync("round_stats")
                 if warm:
                     cur_labels = labels
             else:
@@ -879,7 +925,8 @@ def run_consensus(slab: GraphSlab,
                     config.delta, n_closure, ensemble_sharding, sampler,
                     config.closure_tau)
                 t0 = time.perf_counter()
-                with tracer.span("round", r=r, mode=mode):
+                # step_span: one profiler step per consensus round
+                with tracer.step_span("round", r, mode=mode):
                     if warm:
                         # align passed traced: flipping it mid-run reuses
                         # the same executable (no endgame recompile); cold
@@ -950,7 +997,11 @@ def run_consensus(slab: GraphSlab,
                         # fcheck: ok=sync-in-loop (labels persisted with
                         # the checkpoint)
                         labels=(np.asarray(cur_labels)
-                                if warm else None))
+                                if warm else None),
+                        # run-scoped fcobs counter totals ride along so
+                        # a resumed process reports cumulative telemetry
+                        # (delta-restored in _resume_from_checkpoint)
+                        telemetry=run_telemetry())
             if converged:
                 break
 

@@ -545,7 +545,11 @@ def _detect_chunked(detect: Detector, slab: GraphSlab, keys: jax.Array,
                 continue
         t0 = time.perf_counter()
         sl = slice(i * members, (i + 1) * members)
-        with tracer.span("detect_chunk", chunk=i, members=members):
+        # tag carries the round (cache_tag embeds "_r{round}"), so a
+        # merged host+device timeline attributes each chunk to its round
+        # even where the enclosing step annotation is unavailable
+        with tracer.span("detect_chunk", chunk=i, members=members,
+                         tag=cache_tag):
             out = call(keys[sl],
                        None if init_labels is None else init_labels[sl])
             # fcheck: ok=sync-in-loop (deliberate: the per-chunk barrier

@@ -15,6 +15,25 @@ stdlib-only modules the engine is permanently instrumented with:
 * **obs/export.py** — JSONL event log, Chrome/Perfetto ``trace_event``
   JSON (open in ``ui.perfetto.dev``), plain-text summary table.
 
+Grown in PR 3 from a host tracer into the full stack:
+
+* **obs/device.py** — device-time attribution: annotating tracers mirror
+  every span into ``jax.profiler`` (``TraceAnnotation`` +
+  per-consensus-round ``StepTraceAnnotation``), ``ProfilerSession``
+  wraps a run, and ``merge_profiler_trace`` grafts the profiler's own
+  Chrome trace into the fcobs Perfetto blob — one merged host+device
+  timeline from ``cli.py --trace --profile-dir``.
+* **obs/roundlog.py** — the folded-in ``utils/trace.py`` surface
+  (``RoundLog`` round logger, ``phase_span``).
+* **obs/history.py** — normalized ``BENCH_*.json`` history, trend
+  report, and the CI regression gate (``scripts/bench_report.py``).
+
+Continuity: counter snapshots persist in checkpoint metadata
+(utils/checkpoint.py) and delta-restore on resume
+(``ObsRegistry.restore_counters``), and ``utils/supervise.py`` rotates
+the JSONL event log across restarts (``export.read_jsonl_chain`` reads
+the chain back as one stream).
+
 Consumers: ``cli.py --trace[=PATH]`` records a run and writes the
 Perfetto + JSONL artifacts; ``bench.py`` emits a ``telemetry`` block
 (compile / host-sync counts, round + detect latency percentiles) in its
@@ -25,6 +44,7 @@ from fastconsensus_tpu.obs.counters import (ObsRegistry,  # noqa: F401
                                             device_memory, fold_round,
                                             get_registry, host_sync,
                                             record_device_memory)
+from fastconsensus_tpu.obs.roundlog import RoundLog, phase_span  # noqa: F401
 from fastconsensus_tpu.obs.tracer import (Tracer, get_tracer,  # noqa: F401
                                           set_tracer, traced, use_tracer)
 
@@ -32,4 +52,5 @@ __all__ = [
     "Tracer", "get_tracer", "set_tracer", "use_tracer", "traced",
     "ObsRegistry", "get_registry", "host_sync", "fold_round",
     "device_memory", "record_device_memory",
+    "RoundLog", "phase_span",
 ]

@@ -70,11 +70,43 @@ class ObsRegistry:
             self._gauges.clear()
             self._series.clear()
 
+    def restore_counters(self, saved: Dict[str, int]) -> Dict[str, int]:
+        """Restore checkpointed counter totals by *delta*: each counter is
+        raised to at least its saved value (``inc`` by ``saved - current``
+        when positive, nothing otherwise).
+
+        This is the telemetry-continuity primitive (utils/checkpoint.py
+        persists ``snapshot()["counters"]`` in the checkpoint metadata): a
+        fresh process resuming a run starts at zero, so the delta restore
+        replays the dead process's totals and every later ``inc`` lands on
+        top — ``--trace`` summaries of a resumed run report cumulative
+        counts.  In the same process that already holds the run's counts
+        (e.g. an immediate in-process resume after convergence) the delta
+        is zero and nothing double-counts.  Returns the applied deltas.
+        """
+        applied: Dict[str, int] = {}
+        with self._lock:
+            for name, value in saved.items():
+                delta = int(value) - self._counters.get(name, 0)
+                if delta > 0:
+                    self._counters[name] = \
+                        self._counters.get(name, 0) + delta
+                    applied[name] = delta
+        return applied
+
     # -- reads -------------------------------------------------------
 
     def counters(self) -> Dict[str, int]:
         with self._lock:
             return dict(self._counters)
+
+    def counters_since(self, base: Dict[str, int]) -> Dict[str, int]:
+        """Positive counter increments since a ``counters()`` snapshot —
+        the run-scoping primitive: a checkpoint must persist THIS run's
+        counts (plus its own restored base), not whatever an earlier run
+        in the same process left in the global registry."""
+        return {k: v - base.get(k, 0) for k, v in self.counters().items()
+                if v - base.get(k, 0) > 0}
 
     def gauges(self) -> Dict[str, float]:
         with self._lock:

@@ -121,13 +121,133 @@ def _ensure_parent(path: str) -> None:
         os.makedirs(parent, exist_ok=True)
 
 
+def write_perfetto_blob(path: str, blob: dict) -> None:
+    """Write an already-built (possibly device-merged) trace blob."""
+    _ensure_parent(path)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(blob, fh)
+        fh.write("\n")
+
+
 def write_perfetto(path: str, events: List[dict],
                    snapshot: Optional[dict] = None,
                    process_name: str = PROCESS_NAME) -> None:
-    _ensure_parent(path)
-    with open(path, "w", encoding="utf-8") as fh:
-        json.dump(to_perfetto(events, snapshot, process_name), fh)
-        fh.write("\n")
+    write_perfetto_blob(path, to_perfetto(events, snapshot, process_name))
+
+
+def _rotated_entries(path: str) -> List[tuple]:
+    """Existing rotated segments of ``path`` as sorted ``(n, path)``
+    pairs — the single owner of the ``{path}.{n}`` chain naming scheme
+    (supervise's rotation derives its next suffix from here too)."""
+    import re
+
+    rotated = []
+    parent = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    pat = re.compile(re.escape(base) + r"\.(\d+)$")
+    if os.path.isdir(parent):
+        for name in os.listdir(parent):
+            m = pat.match(name)
+            if m:
+                rotated.append((int(m.group(1)),
+                                os.path.join(parent, name)))
+    return sorted(rotated)
+
+
+def next_chain_suffix(path: str) -> int:
+    """The suffix the NEXT rotation of ``path`` should use (one past the
+    highest existing ``{path}.{n}``; 1 for an unrotated path)."""
+    entries = _rotated_entries(path)
+    return (entries[-1][0] + 1) if entries else 1
+
+
+class JsonlStreamer:
+    """Incremental JSONL event log: spans flush to disk as they close.
+
+    The batch exporter (:func:`write_jsonl`) writes everything at run
+    end — which is exactly when a stall-killed (SIGKILL) process never
+    gets to run, losing the whole attempt's spans and defeating the
+    supervise rotation chain for the supervisor's PRIMARY failure mode.
+    The streamer appends every span recorded since the last ``flush()``
+    (cli.py flushes once per consensus round), so a killed attempt
+    leaves everything but its in-flight round on disk.  Lines append in
+    span-close order, not ``ts`` order; readers
+    (:func:`read_jsonl_chain`, the summary tooling) sort or rebase by
+    ``ts`` and do not rely on file order.  ``close(snapshot)`` flushes
+    the tail and appends the final counters record.
+    """
+
+    def __init__(self, path: str, tracer) -> None:
+        self.path = path
+        self._tracer = tracer
+        self._n = 0
+        _ensure_parent(path)
+        # truncate: each attempt owns one fresh segment (rotation, not
+        # appending, is how attempts chain — utils/supervise.py)
+        open(path, "w", encoding="utf-8").close()
+
+    def flush(self) -> None:
+        new = self._tracer.events_since(self._n)
+        if not new:
+            return
+        self._n += len(new)
+        with open(self.path, "a", encoding="utf-8") as fh:
+            for ev in new:
+                fh.write(json.dumps({"kind": "span", **ev}) + "\n")
+
+    def close(self, snapshot: Optional[dict] = None) -> None:
+        self.flush()
+        if snapshot is not None:
+            with open(self.path, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps({"kind": "counters", **snapshot})
+                         + "\n")
+
+
+def chain_segments(path: str) -> List[str]:
+    """The rotated-segment chain for an fcobs JSONL log, oldest first.
+
+    ``utils/supervise.py`` rotates a restarting run's event log to
+    ``{path}.1``, ``{path}.2``, ... before each relaunch, so a supervised
+    run that died N times leaves N rotated segments plus the final live
+    file at ``path``.  Returns every existing member in chain order
+    (numeric suffixes ascending, then ``path`` itself).
+    """
+    out = [p for _, p in _rotated_entries(path)]
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def read_jsonl_chain(path: str) -> List[dict]:
+    """One coherent event stream from a rotated JSONL chain.
+
+    Concatenates every segment of :func:`chain_segments` in order; each
+    record gains an ``attempt`` field (1-based segment index), and span
+    records' ``ts`` are rebased onto one cumulative timeline — segment
+    k's spans start where segment k-1's ended (each process's tracer
+    clock restarts at zero, so raw timestamps overlap).  Counter
+    records pass through untouched: with checkpointed counter restore
+    (obs/counters.restore_counters) the LAST counters record is already
+    the run's cumulative truth.
+    """
+    records: List[dict] = []
+    offset = 0
+    for attempt, seg in enumerate(chain_segments(path), start=1):
+        seg_end = 0
+        with open(seg, encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                rec["attempt"] = attempt
+                if rec.get("kind") == "span" and "ts" in rec:
+                    seg_end = max(seg_end,
+                                  rec["ts"] + rec.get("dur", 0))
+                    rec["ts"] = rec["ts"] + offset
+                records.append(rec)
+        offset += seg_end
+    return records
 
 
 def summary_table(events: List[dict],

@@ -1,0 +1,267 @@
+"""fcobs bench history: normalize BENCH artifacts, trend them, gate CI.
+
+The repo accumulates one bench artifact per growth round (``BENCH_r0*.
+json`` — the driver's wrapper object with a ``parsed`` record) plus
+ad-hoc run artifacts (``runs/bench_*.json`` — raw ``bench.py`` JSON
+lines), and until now nothing read them back: a perf regression between
+rounds was whatever a human happened to notice.  This module is the
+reader:
+
+* :func:`load_records` — one normalized record per recognizable bench
+  object in a file, tolerant of the three committed shapes (driver
+  wrapper, raw JSON object, JSON lines) and silently skipping files that
+  are not bench records (e.g. ``BENCH_BASELINE.json``, a cache).
+* :func:`build_history` — records grouped per *config* (parsed from the
+  bench unit string: graph / algorithm / n_p / mesh) and ordered by
+  sequence number (the driver's ``n``, or an ``_rN`` filename suffix).
+* :func:`trend_table` — text/markdown trend report per config:
+  throughput, vs-baseline, NMI, rounds, and the fcobs telemetry columns
+  (warm compiles, host syncs, p95 round / detect-call latency) where the
+  artifact carries them (PR-2+ artifacts do; earlier ones show ``-``).
+* :func:`check_history` — the regression gate: the newest *sequenced*
+  record per config is compared against the median of its predecessors;
+  a throughput drop beyond ``max_drop_frac``, an NMI drop beyond
+  ``nmi_drop``, a converged-run history going non-converged, or a
+  warm-run compile count > 0 is a finding.  Unsequenced ad-hoc records
+  inform the trend table but are never "the latest" — a one-off degraded
+  rerun (e.g. ``runs/bench_emailEu_rerun.json``, a transport-degraded
+  probe) must not fail CI forever.
+
+``scripts/bench_report.py`` is the CLI; ``scripts/ci_check.sh`` runs it
+with ``--check`` as a gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from statistics import median as _median
+from typing import Dict, List, Optional, Tuple
+
+# Thresholds the CI gate uses unless overridden.  max_drop_frac is
+# deliberately loose (a 50% drop): the committed history itself shows
+# benign 10-20% run-to-run noise on the tracked config, and the round-3
+# artifact (6.9 p/s vs 67.7 prior — a 10x transport collapse) is exactly
+# the magnitude the gate exists to catch.
+DEFAULT_MAX_DROP_FRAC = 0.5
+DEFAULT_NMI_DROP = 0.05
+
+
+def _seq_from_name(path: str) -> Optional[int]:
+    """``BENCH_r03.json`` / ``bench_lfr1k_r5.json`` -> 3 / 5; None when
+    the filename carries no round suffix."""
+    m = re.search(r"_r0*(\d+)(?:\.json)?$", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def _config_key(rec: dict) -> str:
+    """Stable per-config grouping key from the bench unit string, e.g.
+    ``partitions/s/chip (lfr=lfr1k, alg=louvain, n_p=50)`` ->
+    ``lfr1k/louvain/np50`` (plus the mesh shape when sharded)."""
+    unit = str(rec.get("unit", ""))
+    m = re.search(r"\(lfr=([^,)]+), *alg=([^,)]+), *n_p=(\d+)\)", unit)
+    if m:
+        # primary: the unit parse — it is the only key the ENTIRE
+        # committed history carries, so old and new artifacts of one
+        # config land in one trajectory
+        key = f"{m.group(1)}/{m.group(2)}/np{m.group(3)}"
+    elif rec.get("config"):
+        # PR-3+ bench.py artifacts carry an explicit config name;
+        # fallback for a future unit-string format change
+        key = str(rec["config"])
+    else:
+        key = str(rec.get("metric", "unknown"))
+    mesh = rec.get("mesh")
+    if mesh and mesh != "1x1":
+        key += f"/mesh{mesh}"
+    return key
+
+
+def _normalize(rec: dict, source: str, seq: Optional[int]) -> dict:
+    tel = rec.get("telemetry") or {}
+
+    def p95(name):
+        s = tel.get(name)
+        return s.get("p95") if isinstance(s, dict) else None
+
+    return {
+        "source": source,
+        "seq": seq,
+        "config": _config_key(rec),
+        "metric": rec.get("metric"),
+        "value": float(rec["value"]),
+        "vs_baseline": rec.get("vs_baseline"),
+        "nmi": rec.get("nmi"),
+        "baseline_nmi": rec.get("baseline_nmi"),
+        "seconds": rec.get("seconds"),
+        "rounds": rec.get("rounds"),
+        "converged": rec.get("converged"),
+        "backend": rec.get("backend"),
+        "mesh": rec.get("mesh"),
+        "rtt_post_ms": rec.get("dispatch_rtt_ms_post"),
+        "compiles_cold": tel.get("compiles_cold"),
+        "compiles_warm": tel.get("compiles_warm"),
+        "host_syncs_total": (sum(tel["host_syncs"].values())
+                             if isinstance(tel.get("host_syncs"), dict)
+                             else None),
+        "round_p95_s": p95("round_s"),
+        "detect_p95_s": p95("detect_call_s"),
+    }
+
+
+def _candidate_records(doc) -> List[dict]:
+    """Bench records inside one parsed JSON document: the document
+    itself, its ``parsed`` field (driver wrapper), or its ``record``
+    field (the VMESH artifact shape) — whichever carry metric+value."""
+    out = []
+    if isinstance(doc, dict):
+        for cand in (doc.get("parsed"), doc.get("record"), doc):
+            if isinstance(cand, dict) and "metric" in cand \
+                    and "value" in cand:
+                out.append(cand)
+                break
+    return out
+
+
+def load_records(path: str) -> List[dict]:
+    """Normalized bench records from one artifact file (possibly JSON
+    lines); [] when the file holds nothing bench-shaped."""
+    try:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    except OSError:
+        return []
+    docs = []
+    try:
+        docs = [json.loads(text)]
+    except json.JSONDecodeError:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                docs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    records = []
+    for doc in docs:
+        seq = (doc["n"] if isinstance(doc, dict)
+               and isinstance(doc.get("n"), int)
+               else _seq_from_name(path))
+        for rec in _candidate_records(doc):
+            records.append(_normalize(rec, os.path.basename(path), seq))
+    return records
+
+
+def build_history(paths: List[str]) -> Dict[str, List[dict]]:
+    """Records from every path, grouped by config key and ordered by
+    (sequence, source) — unsequenced records sort first (they are
+    never "the latest"; see module docstring)."""
+    groups: Dict[str, List[dict]] = {}
+    for path in paths:
+        for rec in load_records(path):
+            groups.setdefault(rec["config"], []).append(rec)
+    for recs in groups.values():
+        recs.sort(key=lambda r: (r["seq"] is not None, r["seq"] or 0,
+                                 r["source"]))
+    return dict(sorted(groups.items()))
+
+
+def _fmt(v, nd=3) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return "yes" if v else "no"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}".rstrip("0").rstrip(".") or "0"
+    return str(v)
+
+
+_COLUMNS: List[Tuple[str, str]] = [
+    ("seq", "seq"), ("source", "source"), ("value", "p/s/chip"),
+    ("vs_baseline", "vs_cpu"), ("nmi", "nmi"), ("rounds", "rounds"),
+    ("converged", "conv"), ("compiles_warm", "warm_compiles"),
+    ("host_syncs_total", "host_syncs"), ("round_p95_s", "round_p95_s"),
+    ("detect_p95_s", "detect_p95_s"), ("rtt_post_ms", "rtt_post_ms"),
+]
+
+
+def trend_table(groups: Dict[str, List[dict]],
+                markdown: bool = False) -> str:
+    """Per-config trend report over the normalized history."""
+    lines: List[str] = []
+    for config, recs in groups.items():
+        header = [h for _, h in _COLUMNS]
+        rows = [[_fmt(r[k]) for k, _ in _COLUMNS] for r in recs]
+        if markdown:
+            lines.append(f"### {config}")
+            lines.append("| " + " | ".join(header) + " |")
+            lines.append("|" + "|".join("---" for _ in header) + "|")
+            lines.extend("| " + " | ".join(row) + " |" for row in rows)
+        else:
+            lines.append(f"== {config} ==")
+            widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+                      for i in range(len(header))]
+            lines.append("  ".join(h.ljust(w)
+                                   for h, w in zip(header, widths)))
+            for row in rows:
+                lines.append("  ".join(c.ljust(w)
+                                       for c, w in zip(row, widths)))
+        lines.append("")
+    return "\n".join(lines).rstrip() or "(no bench records found)"
+
+
+def check_history(groups: Dict[str, List[dict]],
+                  max_drop_frac: float = DEFAULT_MAX_DROP_FRAC,
+                  nmi_drop: float = DEFAULT_NMI_DROP) -> List[str]:
+    """Regression findings over the history; [] means the gate passes.
+
+    Per config, the newest sequenced record(s) are judged against the
+    median of the earlier sequenced ones (median, not min/max: the
+    committed history contains one known transport-collapsed round whose
+    value must neither fail the gate retroactively nor drag the baseline
+    down).  Configs with fewer than two sequenced records have no
+    trajectory to judge and pass.
+    """
+    problems: List[str] = []
+    for config, recs in groups.items():
+        seqd = [r for r in recs if r["seq"] is not None]
+        if len(seqd) < 2:
+            continue
+        latest_seq = max(r["seq"] for r in seqd)
+        latest = [r for r in seqd if r["seq"] == latest_seq]
+        prior = [r for r in seqd if r["seq"] < latest_seq]
+        if not prior:
+            continue
+        base_value = _median([r["value"] for r in prior])
+        prior_nmi = [r["nmi"] for r in prior if r["nmi"] is not None]
+        for r in latest:
+            tag = f"{config} [{r['source']} seq {r['seq']}]"
+            floor = (1.0 - max_drop_frac) * base_value
+            if r["value"] < floor:
+                problems.append(
+                    f"{tag}: throughput {r['value']:.3f} fell below "
+                    f"{floor:.3f} ({max_drop_frac:.0%} drop from the "
+                    f"prior median {base_value:.3f})")
+            if prior_nmi and r["nmi"] is not None and \
+                    r["nmi"] < _median(prior_nmi) - nmi_drop:
+                problems.append(
+                    f"{tag}: NMI {r['nmi']:.4f} dropped more than "
+                    f"{nmi_drop} below the prior median "
+                    f"{_median(prior_nmi):.4f}")
+            prior_conv = [p["converged"] for p in prior
+                          if p["converged"] is not None]
+            # prior_conv must be non-empty: with no prior convergence
+            # evidence at all, all([]) would vacuously "prove" every
+            # prior run converged and fail CI on a false premise
+            if r["converged"] is False and prior_conv and \
+                    all(prior_conv):
+                problems.append(
+                    f"{tag}: run no longer converges (every prior "
+                    f"sequenced run did)")
+            if (r["compiles_warm"] or 0) > 0:
+                problems.append(
+                    f"{tag}: {r['compiles_warm']} warm-run compile(s) — "
+                    f"a retrace regression (telemetry.compiles_warm)")
+    return problems

@@ -4,9 +4,12 @@ Spans measure the *host-visible* phases of a consensus run — rounds,
 detection chunks, executable (re)builds, growth replays, the final
 re-detection — as nested intervals with wall time (``time.perf_counter``)
 and CPU time (``time.process_time``).  Device-side kernel timing belongs
-to ``jax.profiler`` (utils/trace.py:profiler_trace); fcobs answers the
-cheaper, always-available question: where did the driver's wall clock go,
-and how often did it cross the host-device boundary (obs/counters.py).
+to ``jax.profiler`` — and an *annotating* tracer (``Tracer(annotate=
+True)``, obs/device.py) mirrors every span into the profiler's timeline
+as a ``TraceAnnotation`` so the two views share one vocabulary; fcobs
+alone answers the cheaper, always-available question: where did the
+driver's wall clock go, and how often did it cross the host-device
+boundary (obs/counters.py).
 
 Overhead contract: **disabled is the default and costs ~nothing.**  A
 disabled tracer's :meth:`Tracer.span` is one attribute check returning a
@@ -98,15 +101,71 @@ class _Span:
         return False
 
 
-class Tracer:
-    """Collects nested spans; see the module docstring for the contract."""
+class _AnnotatedSpan:
+    """Host span + ``jax.profiler`` annotation entered/exited together.
 
-    def __init__(self, enabled: bool = True) -> None:
+    The annotation is entered first and exited last, so the device-side
+    region fully encloses the host span it names.  Handed out only by
+    annotating tracers (``Tracer(annotate=True)``) — the disabled and
+    host-only paths never construct one.
+    """
+
+    __slots__ = ("_span", "_ann")
+
+    def __init__(self, span: "_Span", ann) -> None:
+        self._span = span
+        self._ann = ann
+
+    def __enter__(self) -> "_Span":
+        self._ann.__enter__()
+        return self._span.__enter__()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        try:
+            return bool(self._span.__exit__(exc_type, exc, tb))
+        finally:
+            self._ann.__exit__(exc_type, exc, tb)
+
+
+class Tracer:
+    """Collects nested spans; see the module docstring for the contract.
+
+    ``annotate=True`` additionally wraps every span in a ``jax.profiler``
+    ``TraceAnnotation`` (and :meth:`step_span` in a
+    ``StepTraceAnnotation``), so a concurrent ``jax.profiler`` trace
+    (obs/device.py ProfilerSession) shows the same span names on the
+    device timeline.  Requested but unavailable annotation (no usable
+    ``jax.profiler``) silently degrades to host-only spans.
+    """
+
+    def __init__(self, enabled: bool = True,
+                 annotate: bool = False) -> None:
         self.enabled = enabled
+        self.annotate = False
+        if annotate:
+            from fastconsensus_tpu.obs import device as obs_device
+
+            if obs_device.available():
+                # bind the profiler classes ONCE: span()/step_span() are
+                # on the per-round / per-detect-chunk hot path and must
+                # not pay a module import lookup + try/except per call
+                import jax.profiler as _prof
+
+                self.annotate = True
+                self._annotation = _prof.TraceAnnotation
+                self._step_annotation = (
+                    lambda name, step: _prof.StepTraceAnnotation(
+                        name, step_num=int(step)))
         self._events: List[dict] = []
         self._lock = threading.Lock()
         self._local = threading.local()
         self._t0 = time.perf_counter()
+
+    @property
+    def t0(self) -> float:
+        """perf_counter value of the tracer's ts=0 (timeline merging —
+        obs/device.ProfilerSession.offset_us)."""
+        return self._t0
 
     # -- recording ---------------------------------------------------
 
@@ -125,10 +184,28 @@ class Tracer:
     def span(self, name: str, **args):
         """Context manager timing a named region; ``args`` become the
         span's Perfetto args.  Returns the shared no-op span when the
-        tracer is disabled (nothing is allocated or recorded)."""
+        tracer is disabled (nothing is allocated or recorded).  An
+        annotating tracer pairs the span with a profiler
+        ``TraceAnnotation`` of the same name."""
         if not self.enabled:
             return _NULL_SPAN
-        return _Span(self, name, args or None)
+        span = _Span(self, name, args or None)
+        if self.annotate:
+            return _AnnotatedSpan(span, self._annotation(name))
+        return span
+
+    def step_span(self, name: str, step: int, **args):
+        """Like :meth:`span`, but the unit of repetition — one consensus
+        round.  ``step`` is recorded in the span args, and an annotating
+        tracer emits a ``StepTraceAnnotation(name, step_num=step)`` so
+        profiler tooling groups the round's device ops per step."""
+        if not self.enabled:
+            return _NULL_SPAN
+        span = _Span(self, name, {"step": int(step), **args})
+        if self.annotate:
+            return _AnnotatedSpan(span,
+                                  self._step_annotation(name, step))
+        return span
 
     def instant(self, name: str, **args) -> None:
         """Record a zero-duration marker (Perfetto ``ph: "i"``)."""
@@ -153,6 +230,14 @@ class Tracer:
         """Snapshot of all finished spans (ordered by span end)."""
         with self._lock:
             return list(self._events)
+
+    def events_since(self, start: int) -> List[dict]:
+        """Finished spans from index ``start`` on — the incremental-
+        export primitive (export.JsonlStreamer): copies only the new
+        tail under the lock, so per-round streaming stays O(new spans)
+        instead of re-copying the whole history every flush."""
+        with self._lock:
+            return list(self._events[start:])
 
     def clear(self) -> None:
         with self._lock:

@@ -38,13 +38,22 @@ def save_checkpoint(path: str,
                     key_data: np.ndarray,
                     history: List[dict],
                     extra: Optional[Dict[str, Any]] = None,
-                    labels: Optional[np.ndarray] = None) -> None:
+                    labels: Optional[np.ndarray] = None,
+                    telemetry: Optional[Dict[str, int]] = None) -> None:
     """Atomically persist the consensus state after a round.
 
     ``labels`` ([n_p, N] int32, optional) is the round's detection output —
     persisted so a warm-started run (consensus.ConsensusConfig.warm_start)
     resumes bit-identically; surfaced by load_checkpoint as
     ``extra["_labels"]``.
+
+    ``telemetry`` (optional) is the fcobs counter snapshot at checkpoint
+    time (``ObsRegistry.counters()``) — telemetry continuity: a resumed
+    process delta-restores these totals (obs/counters.restore_counters)
+    so its ``--trace`` summary reports the RUN's cumulative counts, not
+    just the surviving process's.  Surfaced as ``extra["_telemetry"]``;
+    counters only (series percentiles cannot be merged across processes
+    and are deliberately not persisted).
     """
     meta = {
         "version": _FORMAT_VERSION,
@@ -58,6 +67,8 @@ def save_checkpoint(path: str,
         "history": history,
         "extra": extra or {},
     }
+    if telemetry:
+        meta["telemetry"] = {k: int(v) for k, v in telemetry.items()}
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)),
                                suffix=".tmp")
@@ -107,6 +118,8 @@ def load_checkpoint(path: str
         extra = dict(meta["extra"])
         if meta.get("version") == 1:
             extra["_legacy_v1"] = True
+        if meta.get("telemetry"):
+            extra["_telemetry"] = dict(meta["telemetry"])
         if "labels" in z.files:
             extra["_labels"] = z["labels"].copy()
         return (slab, int(meta["rounds_done"]), z["key_data"].copy(),

@@ -14,9 +14,18 @@ layers compose:
   persistence layers above, each rerun resumes within the round it died
   in, so total lost work per failure is bounded by one detection chunk.
 
+Telemetry continuity (fcobs): each restart of a ``--trace`` child would
+overwrite the previous attempt's event log; ``--rotate PATH`` chains the
+artifacts instead (``PATH.1``, ``PATH.2``, ... per dead attempt —
+:func:`rotate_for_retry`), ``obs/export.read_jsonl_chain`` reads the
+chain back as one cumulative stream, and checkpointed counter snapshots
+(utils/checkpoint.py) make each attempt's counters resume where the dead
+one stopped.
+
 CLI: ``python -m fastconsensus_tpu.utils.supervise --progress rounds.jsonl
+--rotate trace.json --rotate trace.json.jsonl
 -- python -m fastconsensus_tpu.cli -f g.txt --checkpoint ck.npz --resume
---detect-cache cache --trace-jsonl rounds.jsonl ...``
+--detect-cache cache --trace trace.json --trace-jsonl rounds.jsonl ...``
 """
 
 from __future__ import annotations
@@ -29,12 +38,42 @@ import time
 from typing import List, Optional, Sequence
 
 
+def rotate_for_retry(paths: Sequence[str], log=print) -> None:
+    """Rotate per-attempt artifacts before relaunching a failed child.
+
+    Each existing ``path`` moves to ``{path}.{k}`` with ``k`` one past
+    the highest existing numeric suffix (obs/export.next_chain_suffix —
+    the chain reader and this rotation share one naming scheme), so a
+    run that died N times leaves the segments ``path.1 .. path.N`` plus
+    the final attempt's live file at ``path`` — the chain
+    ``obs/export.read_jsonl_chain`` reads back as one cumulative stream.
+    Without rotation each restart of a ``--trace`` run *overwrites* the
+    event log, reducing a 13-attempt run's telemetry to its last
+    fragment.
+
+    The chain is append-only and per-path: like the detect cache, use
+    fresh paths per logical run — re-supervising the SAME run (e.g. the
+    supervisor host rebooted mid ``--resume`` sequence) legitimately
+    extends the chain, but pointing a new, unrelated run at old paths
+    would splice two runs into one stream.
+    """
+    from fastconsensus_tpu.obs.export import next_chain_suffix
+
+    for path in paths:
+        if not os.path.exists(path):
+            continue
+        dest = f"{path}.{next_chain_suffix(path)}"
+        os.replace(path, dest)
+        log(f"[supervise] rotated {path} -> {dest}")
+
+
 def run_supervised(argv: Sequence[str],
                    progress_path: str,
                    stall_seconds: float = 300.0,
                    recover_seconds: float = 90.0,
                    max_attempts: int = 10,
                    poll_seconds: float = 5.0,
+                   rotate: Sequence[str] = (),
                    log=print) -> int:
     """Run ``argv`` until it exits 0, restarting on stall or failure.
 
@@ -43,6 +82,11 @@ def run_supervised(argv: Sequence[str],
     ignores SIGTERM) and, after ``recover_seconds`` for the transport to
     recover, rerun.  Returns the final exit code (0 on success, the last
     child's code otherwise).
+
+    ``rotate``: files to chain-rotate (:func:`rotate_for_retry`) before
+    every relaunch — point it at the child's fcobs artifacts (the
+    ``--trace`` JSONL sidecar, the Perfetto JSON) so each attempt's
+    telemetry survives instead of being overwritten by the next.
     """
     import signal
 
@@ -63,6 +107,12 @@ def run_supervised(argv: Sequence[str],
             child.kill()
         child.wait()
 
+    # Fence before attempt 1: a live artifact left behind by a PREVIOUS
+    # supervision of this run (supervisor killed/rebooted mid-sequence)
+    # becomes a chain segment instead of being overwritten by the first
+    # relaunch — the chain stays one coherent stream across supervisor
+    # restarts of the same resumable run.
+    rotate_for_retry(rotate, log=log)
     rc = 1
     for attempt in range(1, max_attempts + 1):
         log(f"[supervise] attempt {attempt}/{max_attempts}: "
@@ -101,6 +151,7 @@ def run_supervised(argv: Sequence[str],
         log(f"[supervise] attempt {attempt} ended rc={rc}"
             f"{' (stall-killed)' if killed else ''}")
         if attempt < max_attempts:
+            rotate_for_retry(rotate, log=log)
             log(f"[supervise] waiting {recover_seconds:.0f}s before retry")
             time.sleep(recover_seconds)
     return rc
@@ -117,6 +168,13 @@ def main(args: Optional[List[str]] = None) -> int:
     p.add_argument("--stall-seconds", type=float, default=300.0)
     p.add_argument("--recover-seconds", type=float, default=90.0)
     p.add_argument("--max-attempts", type=int, default=10)
+    p.add_argument("--poll-seconds", type=float, default=5.0)
+    p.add_argument("--rotate", action="append", default=[],
+                   metavar="PATH",
+                   help="rotate PATH to PATH.<n> before each retry "
+                        "(repeatable; point at the child's fcobs "
+                        "--trace artifacts so every attempt's telemetry "
+                        "chains instead of being overwritten)")
     ns, rest = p.parse_known_args(args)
     if rest and rest[0] == "--":
         rest = rest[1:]
@@ -125,7 +183,9 @@ def main(args: Optional[List[str]] = None) -> int:
     return run_supervised(rest, ns.progress,
                           stall_seconds=ns.stall_seconds,
                           recover_seconds=ns.recover_seconds,
-                          max_attempts=ns.max_attempts)
+                          max_attempts=ns.max_attempts,
+                          poll_seconds=ns.poll_seconds,
+                          rotate=ns.rotate)
 
 
 if __name__ == "__main__":

@@ -1,84 +1,64 @@
-"""Structured tracing / observability.
+"""Deprecation shims: this surface moved into fcobs (``obs/``).
 
-The reference's only observability is print statements in its debug variant
-(``new_consensus.py:140-283``: iteration counter + per-phase edge counts;
-SURVEY.md §5).  Here the same signals are structured:
+The pre-fcobs tracing helpers lived here; their real implementations are
+now part of the observability subsystem so one artifact carries every
+host signal:
 
-* :class:`RoundTracer` — an ``on_round`` hook for ``run_consensus`` that
-  logs each round's stats (edges alive, unconverged fraction, closure /
-  repair counts — the exact quantities nc prints) through :mod:`logging`
-  and keeps machine-readable records;
-* :func:`profiler_trace` — optional ``jax.profiler`` context producing a
-  TensorBoard-loadable device trace for kernel-level timing;
-* :func:`phase_timer` — wall-clock phase timing for host-side stages
-  (pack, rounds, final detection, write-out).
+* ``RoundTracer``  -> :class:`fastconsensus_tpu.obs.roundlog.RoundLog`
+* ``phase_timer``  -> :func:`fastconsensus_tpu.obs.roundlog.phase_span`
+* ``profiler_trace`` -> :class:`fastconsensus_tpu.obs.device.
+  ProfilerSession` (which also anchors the clock for host+device
+  timeline merging)
+
+The names below keep existing callers and ``runs/`` scripts working;
+each emits a ``DeprecationWarning`` pointing at its fcobs home.  New
+code should import from ``fastconsensus_tpu.obs``.
 """
 
 from __future__ import annotations
 
 import contextlib
-import json
 import logging
-import time
-from typing import Dict, List, Optional
+import warnings
+from typing import Dict, Optional
 
-logger = logging.getLogger("fastconsensus_tpu")
+from fastconsensus_tpu.obs.device import ProfilerSession
+from fastconsensus_tpu.obs.roundlog import RoundLog, logger, phase_span
+
+__all__ = ["RoundTracer", "phase_timer", "profiler_trace", "logger"]
 
 
-class RoundTracer:
-    """Collects per-round stats; pass ``tracer.on_round`` to run_consensus."""
+def _warn(old: str, new: str) -> None:
+    warnings.warn(f"fastconsensus_tpu.utils.trace.{old} moved to "
+                  f"fastconsensus_tpu.obs ({new}); this shim will go "
+                  f"away", DeprecationWarning, stacklevel=3)
+
+
+class RoundTracer(RoundLog):
+    """Deprecated alias of :class:`fastconsensus_tpu.obs.roundlog.
+    RoundLog` (identical behavior, including ``.records`` and the
+    ``jsonl_path`` sidecar)."""
 
     def __init__(self, log_level: int = logging.INFO,
                  jsonl_path: Optional[str] = None):
-        self.records: List[dict] = []
-        self._level = log_level
-        self._jsonl_path = jsonl_path
-        self._t0 = time.perf_counter()
-        self._last = self._t0
-
-    def on_round(self, entry: Dict) -> None:
-        now = time.perf_counter()
-        rec = dict(entry)
-        rec["round_seconds"] = round(now - self._last, 4)
-        rec["elapsed_seconds"] = round(now - self._t0, 4)
-        self._last = now
-        self.records.append(rec)
-        frac = (rec["n_unconverged"] / rec["n_alive"]
-                if rec["n_alive"] else 0.0)
-        logger.log(self._level,
-                   "round %d: %d edges alive, %d unconverged (%.1f%%), "
-                   "+%d closure, +%d repaired, %d dropped [%.2fs]",
-                   rec["round"], rec["n_alive"], rec["n_unconverged"],
-                   100.0 * frac, rec["n_closure_added"], rec["n_repaired"],
-                   rec["n_dropped"], rec["round_seconds"])
-        if self._jsonl_path:
-            with open(self._jsonl_path, "a") as fh:
-                fh.write(json.dumps(rec) + "\n")
-
-
-@contextlib.contextmanager
-def profiler_trace(log_dir: Optional[str]):
-    """Wrap a region in a jax.profiler trace (no-op when log_dir is None)."""
-    if not log_dir:
-        yield
-        return
-    import jax
-
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+        _warn("RoundTracer", "roundlog.RoundLog")
+        super().__init__(log_level=log_level, jsonl_path=jsonl_path)
 
 
 @contextlib.contextmanager
 def phase_timer(name: str, sink: Optional[Dict[str, float]] = None,
                 level: int = logging.DEBUG):
-    t0 = time.perf_counter()
-    try:
+    """Deprecated alias of :func:`fastconsensus_tpu.obs.roundlog.
+    phase_span`."""
+    _warn("phase_timer", "roundlog.phase_span")
+    with phase_span(name, sink=sink, level=level):
         yield
-    finally:
-        dt = time.perf_counter() - t0
-        logger.log(level, "phase %s: %.3fs", name, dt)
-        if sink is not None:
-            sink[name] = sink.get(name, 0.0) + dt
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: Optional[str]):
+    """Deprecated alias of :class:`fastconsensus_tpu.obs.device.
+    ProfilerSession` (no-op when ``log_dir`` is None)."""
+    _warn("profiler_trace", "device.ProfilerSession")
+    with ProfilerSession(log_dir):
+        yield

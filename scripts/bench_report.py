@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Bench-history trend report + regression gate (fcobs obs/history.py).
+
+    python scripts/bench_report.py                 # trend report (text)
+    python scripts/bench_report.py --markdown      # trend report (md)
+    python scripts/bench_report.py --check         # CI gate: exit 1 on a
+                                                   # detected regression
+
+With no paths, ingests the committed history: ``BENCH_*.json`` at the
+repo root plus ``runs/bench_*.json``.  Files that are not bench records
+(the CPU-baseline cache, scaling notes) are skipped silently — pass
+explicit paths to restrict the set.  ``--check`` judges the newest
+sequenced artifact per config against the median of its predecessors
+(thresholds: ``--max-drop-frac``, ``--nmi-drop``; see
+obs/history.check_history for the exact rules) and exits non-zero with
+one line per finding.  Needs no TPU and never imports jax: obs/history.py
+is stdlib-only and is loaded by file path below, because importing it
+through the ``fastconsensus_tpu`` package would run the package
+``__init__`` (graph.py -> jax) — on a box with no jax, or a wedged TPU
+transport where jax init hangs, the gate must still run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import os
+import sys
+from typing import List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_history():
+    path = os.path.join(REPO, "fastconsensus_tpu", "obs", "history.py")
+    spec = importlib.util.spec_from_file_location("fcobs_history", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+history = _load_history()
+
+
+def default_paths() -> List[str]:
+    return sorted(glob.glob(os.path.join(REPO, "BENCH_*.json"))) + \
+        sorted(glob.glob(os.path.join(REPO, "runs", "bench_*.json")))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="scripts/bench_report.py",
+        description="fcobs bench-history trend report / regression gate")
+    p.add_argument("paths", nargs="*",
+                   help="bench artifact files (default: the committed "
+                        "BENCH_*.json + runs/bench_*.json history)")
+    p.add_argument("--check", action="store_true",
+                   help="regression gate: exit 1 when the newest "
+                        "sequenced record regresses vs its history")
+    p.add_argument("--max-drop-frac", type=float,
+                   default=history.DEFAULT_MAX_DROP_FRAC, metavar="FRAC",
+                   help="throughput-drop fraction vs the prior median "
+                        "that counts as a regression (default: "
+                        f"{history.DEFAULT_MAX_DROP_FRAC})")
+    p.add_argument("--nmi-drop", type=float,
+                   default=history.DEFAULT_NMI_DROP, metavar="D",
+                   help="NMI drop below the prior median that counts as "
+                        f"a regression (default: {history.DEFAULT_NMI_DROP})")
+    p.add_argument("--markdown", action="store_true",
+                   help="emit the trend report as markdown tables")
+    p.add_argument("--quiet", action="store_true",
+                   help="with --check: print findings only, no report")
+    args = p.parse_args(argv)
+
+    if not 0.0 < args.max_drop_frac <= 1.0:
+        p.error(f"--max-drop-frac {args.max_drop_frac} out of range "
+                f"(0, 1]")
+    paths = args.paths or default_paths()
+    groups = history.build_history(paths)
+    if not groups:
+        print("no bench records found in "
+              f"{len(paths)} file(s)", file=sys.stderr)
+        return 2
+    if not args.quiet:
+        print(history.trend_table(groups, markdown=args.markdown))
+    if not args.check:
+        return 0
+    problems = history.check_history(groups,
+                                     max_drop_frac=args.max_drop_frac,
+                                     nmi_drop=args.nmi_drop)
+    n_recs = sum(len(r) for r in groups.values())
+    if problems:
+        print(f"\nbench_report: {len(problems)} regression finding(s) "
+              f"over {n_recs} record(s):", file=sys.stderr)
+        for prob in problems:
+            print(f"  REGRESSION: {prob}", file=sys.stderr)
+        return 1
+    print(f"\nbench_report: no regressions across {len(groups)} "
+          f"config(s) / {n_recs} record(s)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,12 +32,26 @@ if [ "$fixture_rc" -ne 1 ]; then
     exit 1
 fi
 
-echo "== fcobs: traced-consensus smoke (artifacts must parse) =="
+echo "== fcobs: bench-history regression gate (scripts/bench_report.py) =="
+# judges the committed BENCH_*.json / runs/bench_*.json history; no TPU,
+# no jax — exit 1 means the newest sequenced artifact regressed
+python scripts/bench_report.py --check --quiet
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "bench_report --check failed (exit $rc): bench-history" \
+         "regression (or unreadable history)" >&2
+    exit $rc
+fi
+
+echo "== fcobs: traced-consensus smoke (merged artifacts must parse) =="
 SMOKE_DIR=$(mktemp -d)
 trap 'rm -rf "$SMOKE_DIR"' EXIT
+# --trace + --profile-dir on CPU: the merged-timeline path with NO device
+# track available — the trace must still parse and say it is host-only
 JAX_PLATFORMS=cpu python -m fastconsensus_tpu.cli -f examples/karate_club.txt \
     --alg lpm -np 4 -d 0.1 --max-rounds 2 --seed 1 --quiet \
-    --out-dir "$SMOKE_DIR" --trace "$SMOKE_DIR/trace.json"
+    --out-dir "$SMOKE_DIR" --trace "$SMOKE_DIR/trace.json" \
+    --profile-dir "$SMOKE_DIR/prof"
 rc=$?
 if [ $rc -ne 0 ]; then
     echo "traced consensus smoke run failed (exit $rc)" >&2
@@ -47,15 +61,23 @@ JAX_PLATFORMS=cpu python - "$SMOKE_DIR/trace.json" <<'PYEOF'
 import json, sys
 path = sys.argv[1]
 blob = json.load(open(path))
-xs = [e for e in blob["traceEvents"] if e.get("ph") == "X"]
-assert xs, "perfetto trace recorded no spans"
-ts = [e["ts"] for e in xs]
+fcobs = [e for e in blob["traceEvents"]
+         if e.get("ph") == "X" and e.get("cat") == "fcobs"]
+assert fcobs, "perfetto trace recorded no fcobs spans"
+ts = [e["ts"] for e in fcobs]
 assert ts == sorted(ts), "perfetto ts not monotonically ordered"
+# device attribution must degrade loudly, not silently: on CPU the merge
+# either ran host-only (device_track False) or explains why it didn't
+attrib = blob.get("otherData", {}).get("device_attribution")
+assert attrib is not None, "merged trace lacks device_attribution info"
+assert attrib.get("merged") or attrib.get("reason"), attrib
 lines = [json.loads(line) for line in open(path + ".jsonl")]
 assert lines and lines[-1]["kind"] == "counters", "jsonl missing counters"
 assert lines[-1]["counters"].get("rounds.total", 0) >= 1, "no rounds counted"
-print(f"fcobs smoke ok: {len(xs)} spans, "
-      f"{lines[-1]['counters']['rounds.total']} round(s) counted")
+print(f"fcobs smoke ok: {len(fcobs)} spans, "
+      f"{lines[-1]['counters']['rounds.total']} round(s) counted, "
+      f"device_attribution={attrib.get('merged')}/"
+      f"{attrib.get('device_track')}")
 PYEOF
 rc=$?
 if [ $rc -ne 0 ]; then

@@ -172,8 +172,14 @@ def test_resume_rejects_mismatched_config(tmp_path):
     run_consensus(slab, detect, cfg, checkpoint_path=path)
     bad = ConsensusConfig(algorithm="lpm", n_p=4, tau=0.5, delta=0.0,
                           max_rounds=2, seed=3)
+    from fastconsensus_tpu.obs import get_registry
+
+    get_registry().reset()  # fresh process resuming the wrong config
     with pytest.raises(ValueError, match="different run configuration"):
         run_consensus(slab, detect, bad, checkpoint_path=path, resume=True)
+    # the REJECTED resume must not leak the dead run's counters into the
+    # live registry (telemetry restore runs only after validation)
+    assert get_registry().counters().get("rounds.total", 0) == 0
 
 
 def test_resume_after_convergence_is_a_noop(tmp_path):
@@ -189,6 +195,104 @@ def test_resume_after_convergence_is_a_noop(tmp_path):
     assert again.converged and again.rounds == first.rounds
     assert np.array_equal(np.asarray(again.graph.weight),
                           np.asarray(first.graph.weight))
+
+
+def test_resumed_run_reports_cumulative_counters(tmp_path):
+    """Telemetry continuity (the ROADMAP "counter deltas in checkpoint
+    metadata" item): a checkpoint carries the fcobs counter snapshot, and
+    a resumed run in a FRESH process (simulated by resetting the
+    process-global registry) delta-restores it — so the resumed run's
+    totals are cumulative over the whole run, not just the survivor."""
+    from fastconsensus_tpu.obs import get_registry
+    from fastconsensus_tpu.utils.checkpoint import load_checkpoint
+
+    registry = get_registry()
+    slab = _slab()
+    detect = get_detector("lpm")
+    path = str(tmp_path / "ck.npz")
+    cfg1 = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.0,
+                           max_rounds=1, seed=3)
+    registry.reset()
+    first = run_consensus(slab, detect, cfg1, checkpoint_path=path)
+    first_counts = registry.counters()
+    assert first.rounds == 1 and first_counts["rounds.total"] == 1
+
+    # the snapshot rode along in the checkpoint metadata
+    extra = load_checkpoint(path)[4]
+    assert extra["_telemetry"]["rounds.total"] == 1
+    # snapshotted at checkpoint time — i.e. before the run's final
+    # re-detection added its syncs
+    assert 1 <= extra["_telemetry"]["host_sync.total"] <= \
+        first_counts["host_sync.total"]
+
+    # "new process": zeroed registry; the resume restores + accumulates
+    registry.reset()
+    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.0,
+                          max_rounds=3, seed=3)
+    resumed = run_consensus(slab, detect, cfg, checkpoint_path=path,
+                            resume=True)
+    counts = registry.counters()
+    # the resumed process itself only ran rounds 2..N, but its counters
+    # report the whole run (round 1 restored from the checkpoint)
+    assert resumed.rounds >= 2
+    assert counts["rounds.total"] == resumed.rounds == \
+        len(resumed.history), \
+        "resumed run restarted counters at zero instead of cumulating"
+    assert counts["host_sync.total"] > first_counts["host_sync.total"]
+    # and the checkpoint written BY the resumed process carries the
+    # cumulative totals forward (continuity chains across N restarts)
+    extra = load_checkpoint(path)[4]
+    assert extra["_telemetry"]["rounds.total"] == resumed.rounds
+    registry.reset()
+
+
+def test_checkpoint_telemetry_is_run_scoped(tmp_path):
+    """Counts an unrelated earlier run left in the process-global
+    registry must NOT leak into a later run's checkpoint telemetry (the
+    library-usage pattern: nobody resets the registry between runs)."""
+    from fastconsensus_tpu.obs import get_registry
+    from fastconsensus_tpu.utils.checkpoint import load_checkpoint
+
+    registry = get_registry()
+    registry.reset()
+    slab = _slab()
+    detect = get_detector("lpm")
+    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=0.0,
+                          max_rounds=2, seed=3)
+    run_a = run_consensus(slab, detect, cfg)  # no checkpoint
+    assert registry.counters()["rounds.total"] == run_a.rounds
+
+    path = str(tmp_path / "ck.npz")
+    run_b = run_consensus(slab, detect, cfg, checkpoint_path=path)
+    # the registry is (by design) process-cumulative...
+    assert registry.counters()["rounds.total"] == \
+        run_a.rounds + run_b.rounds
+    # ...but run B's checkpoint carries run B's counts only
+    extra = load_checkpoint(path)[4]
+    assert extra["_telemetry"]["rounds.total"] == run_b.rounds
+    registry.reset()
+
+
+def test_resume_in_same_process_does_not_double_count(tmp_path):
+    """The delta restore must be a no-op when the process already holds
+    the run's counts (immediate in-process resume after convergence)."""
+    from fastconsensus_tpu.obs import get_registry
+
+    registry = get_registry()
+    slab = _slab()
+    detect = get_detector("lpm")
+    path = str(tmp_path / "ck.npz")
+    cfg = ConsensusConfig(algorithm="lpm", n_p=8, tau=0.5, delta=1.0,
+                          max_rounds=4, seed=3)  # delta=1: converges r1
+    registry.reset()
+    first = run_consensus(slab, detect, cfg, checkpoint_path=path)
+    assert first.converged and registry.counters()["rounds.total"] == 1
+    again = run_consensus(slab, detect, cfg, checkpoint_path=path,
+                          resume=True)
+    assert again.rounds == first.rounds
+    assert registry.counters()["rounds.total"] == 1, \
+        "in-process resume double-counted the restored snapshot"
+    registry.reset()
 
 
 def test_round_tracer_records_and_logs(tmp_path, caplog):

@@ -115,6 +115,122 @@ def test_tracer_is_thread_safe():
         assert by[f"w{i}.child"]["parent"] == f"w{i}"
 
 
+# ----------------------------------------------------- device attribution
+
+def test_annotated_tracer_still_records_host_spans():
+    """Tracer(annotate=True) wraps spans in jax.profiler annotations
+    (available on every backend) without changing the host-span record;
+    step_span records the step in the span args."""
+    from fastconsensus_tpu.obs import Tracer
+    from fastconsensus_tpu.obs import device as obs_device
+
+    assert obs_device.available()
+    tr = Tracer(annotate=True)
+    assert tr.annotate
+    with tr.step_span("round", 3, mode="warm"):
+        with tr.span("detect", r=3):
+            pass
+    events = tr.events()
+    assert [e["name"] for e in events] == ["detect", "round"]
+    by = {e["name"]: e for e in events}
+    assert by["round"]["args"] == {"step": 3, "mode": "warm"}
+    assert by["detect"]["parent"] == "round"
+    # disabled tracers never pay the annotation path
+    from fastconsensus_tpu.obs.tracer import _NULL_SPAN
+
+    off = Tracer(enabled=False, annotate=True)
+    assert off.step_span("round", 0) is _NULL_SPAN
+
+
+def test_profiler_session_merge_host_only(tmp_path, registry):
+    """ProfilerSession + annotated spans + merge_profiler_trace: on CPU
+    the merged blob parses, carries both the fcobs spans and the
+    profiler's (host-only) events, says device_track=False, and drops
+    the per-python-frame noise."""
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.obs import Tracer
+    from fastconsensus_tpu.obs import export as obs_export
+    from fastconsensus_tpu.obs.device import (ProfilerSession,
+                                              merge_profiler_trace)
+
+    prof_dir = str(tmp_path / "prof")
+    tr = Tracer(annotate=True)
+    f = jax.jit(lambda a: a * 2 + 1)
+    with ProfilerSession(prof_dir) as prof:
+        assert prof.active and prof.start_pc is not None
+        with tr.step_span("round", 0):
+            f(jnp.ones((32,))).block_until_ready()
+    blob = obs_export.to_perfetto(tr.events(), registry.snapshot())
+    merged, info = merge_profiler_trace(blob, prof_dir,
+                                        offset_us=prof.offset_us(tr.t0))
+    assert info["merged"] and not info["device_track"]
+    assert info["python_frames_dropped"] > 0
+    attrib = merged["otherData"]["device_attribution"]
+    assert attrib == info
+    cats = {e.get("cat") for e in merged["traceEvents"]}
+    assert "fcobs" in cats
+    # profiler events survived the merge alongside the fcobs track
+    assert any(e.get("cat") != "fcobs" and e.get("ph") == "X"
+               and not str(e.get("name", "")).startswith("$")
+               for e in merged["traceEvents"])
+    json.dumps(merged)  # artifact stays JSON-serializable
+
+
+def test_finalize_merge_skips_stale_traces_and_stamps_no_start(
+        tmp_path, registry):
+    """finalize_merge (the cli.py/bench.py policy): a trace file left by
+    an EARLIER session in a reused --profile-dir is never grafted (it
+    would land at the wrong offset), and a session that never started is
+    stamped rather than merged."""
+    import gzip
+    import os
+    import time
+
+    from fastconsensus_tpu.obs import export as obs_export
+    from fastconsensus_tpu.obs.device import ProfilerSession, finalize_merge
+
+    prof_dir = tmp_path / "prof"
+    run_dir = prof_dir / "plugins" / "profile" / "2020_01_01"
+    run_dir.mkdir(parents=True)
+    stale = run_dir / "host.trace.json.gz"
+    with gzip.open(stale, "wt") as fh:
+        fh.write(json.dumps({"traceEvents": [
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 0, "dur": 1,
+             "name": "stale"}]}))
+    old = time.time() - 3600
+    os.utime(stale, (old, old))
+
+    blob = obs_export.to_perfetto(_sample_events(), registry.snapshot())
+    # a "started" session whose stop produced no NEW trace file
+    sess = ProfilerSession(str(prof_dir))
+    sess.start_pc = time.perf_counter()
+    sess.start_wall = time.time()
+    merged, info = finalize_merge(blob, sess, sess.start_pc)
+    assert not info["merged"] and "fresh" in info["reason"]
+    assert not any(e.get("name") == "stale"
+                   for e in merged["traceEvents"])
+    # never-started session: stamped with the start-failure reason
+    merged, info = finalize_merge(blob, ProfilerSession(str(prof_dir)),
+                                  0.0)
+    assert not info["merged"] and "failed to start" in info["reason"]
+    assert merged["otherData"]["device_attribution"] == info
+
+
+def test_merge_degrades_gracefully_without_profile(tmp_path, registry):
+    """No profiler output under the dir: the blob comes back unmerged
+    but *annotated* with the reason — never an exception."""
+    from fastconsensus_tpu.obs import export as obs_export
+    from fastconsensus_tpu.obs.device import merge_profiler_trace
+
+    blob = obs_export.to_perfetto(_sample_events(), registry.snapshot())
+    merged, info = merge_profiler_trace(blob, str(tmp_path / "empty"))
+    assert not info["merged"] and "reason" in info
+    assert merged["otherData"]["device_attribution"] == info
+    assert len(merged["traceEvents"]) == len(blob["traceEvents"])
+
+
 # -------------------------------------------------------------- registry
 
 def test_registry_counters_gauges_series(registry):
@@ -258,6 +374,92 @@ def test_jsonl_export_roundtrips(tmp_path, registry):
     assert lines[-1]["counters"]["x"] == 5
 
 
+def test_jsonl_chain_reader_rebases_ts_across_segments(tmp_path,
+                                                       registry):
+    """Rotated JSONL segments (supervise restarts) read back as ONE
+    stream: attempt numbers attach, span timestamps chain monotonically
+    even though each process's tracer clock restarted at zero."""
+    from fastconsensus_tpu.obs import export as obs_export
+
+    path = str(tmp_path / "trace.json.jsonl")
+    # two dead attempts + the live file, each with its own zero-based ts
+    registry.inc("rounds.total", 1)
+    obs_export.write_jsonl(path + ".1", _sample_events(),
+                           registry.snapshot())
+    registry.inc("rounds.total", 1)
+    obs_export.write_jsonl(path + ".2", _sample_events(),
+                           registry.snapshot())
+    registry.inc("rounds.total", 1)
+    obs_export.write_jsonl(path, _sample_events(), registry.snapshot())
+
+    assert obs_export.chain_segments(path) == [path + ".1", path + ".2",
+                                               path]
+    records = obs_export.read_jsonl_chain(path)
+    assert {r["attempt"] for r in records} == {1, 2, 3}
+    spans = [r for r in records if r["kind"] == "span"]
+    ts = [r["ts"] for r in spans]
+    assert ts == sorted(ts), "chained ts not rebased monotonically"
+    # later attempts start after earlier ones end
+    first_of = {a: min(r["ts"] for r in spans if r["attempt"] == a)
+                for a in (1, 2, 3)}
+    last_of = {a: max(r["ts"] + r.get("dur", 0) for r in spans
+                      if r["attempt"] == a) for a in (1, 2, 3)}
+    assert first_of[2] >= last_of[1] and first_of[3] >= last_of[2]
+    # the final counters record is the cumulative truth
+    counters = [r for r in records if r["kind"] == "counters"]
+    assert counters[-1]["attempt"] == 3
+    assert counters[-1]["counters"]["rounds.total"] == 3
+
+
+def test_jsonl_streamer_survives_abrupt_death(tmp_path, registry):
+    """The CLI's .jsonl sidecar streams per flush: a SIGKILLed process
+    (no close(), no finally) still leaves every flushed span on disk,
+    and the chain reader copes with the counters-less segment."""
+    from fastconsensus_tpu.obs import Tracer
+    from fastconsensus_tpu.obs import export as obs_export
+
+    path = str(tmp_path / "t.jsonl")
+    tr = Tracer()
+    streamer = obs_export.JsonlStreamer(path, tr)
+    with tr.span("round", r=0):
+        pass
+    streamer.flush()
+    with tr.span("round", r=1):
+        pass
+    streamer.flush()
+    streamer.flush()  # nothing new: no-op, no duplicate lines
+    # process dies here — close() never runs
+    lines = [json.loads(ln) for ln in open(path)]
+    assert [ln["kind"] for ln in lines] == ["span", "span"]
+    assert [ln["args"]["r"] for ln in lines] == [0, 1]
+    records = obs_export.read_jsonl_chain(path)
+    assert len(records) == 2 and all(r["attempt"] == 1 for r in records)
+    # graceful path: close() appends the counters record
+    registry.inc("rounds.total", 2)
+    streamer.close(registry.snapshot())
+    lines = [json.loads(ln) for ln in open(path)]
+    assert lines[-1]["kind"] == "counters"
+    assert lines[-1]["counters"]["rounds.total"] == 2
+
+
+def test_restore_counters_is_a_delta_restore(registry):
+    """restore_counters raises counters to at least the saved totals —
+    full restore into a fresh registry, no double-count when the counts
+    are already present (the in-process re-resume case)."""
+    saved = {"rounds.total": 5, "host_sync.total": 9}
+    applied = registry.restore_counters(saved)
+    assert applied == saved
+    assert registry.counters() == saved
+    # already-present counts: nothing re-applied
+    assert registry.restore_counters(saved) == {}
+    assert registry.counters() == saved
+    # partially-present: only the missing delta lands
+    registry.inc("rounds.total", 2)   # 7 now
+    applied = registry.restore_counters({"rounds.total": 10, "new": 1})
+    assert applied == {"rounds.total": 3, "new": 1}
+    assert registry.counters()["rounds.total"] == 10
+
+
 def test_summary_table_formats(registry):
     from fastconsensus_tpu.obs import export as obs_export
 
@@ -294,3 +496,30 @@ def test_cli_trace_writes_perfetto_and_jsonl(tmp_path, registry):
     from fastconsensus_tpu.obs import get_tracer
 
     assert not get_tracer().enabled
+
+
+def test_cli_trace_with_profile_dir_merges_one_timeline(tmp_path,
+                                                        registry):
+    """--trace + --profile-dir on CPU: one Perfetto artifact that
+    parses, keeps the fcobs spans ts-ordered, and records the
+    device-attribution outcome (host-only here — no device track)."""
+    from fastconsensus_tpu.cli import main
+
+    trace = tmp_path / "merged_trace.json"
+    rc = main(["-f", KARATE, "--alg", "lpm", "-np", "4", "-d", "0.1",
+               "--max-rounds", "2", "--seed", "1",
+               "--out-dir", str(tmp_path), "--quiet",
+               "--trace", str(trace),
+               "--profile-dir", str(tmp_path / "prof")])
+    assert rc == 0
+    blob = json.load(open(trace))
+    fcobs = [e for e in blob["traceEvents"]
+             if e.get("ph") == "X" and e.get("cat") == "fcobs"]
+    assert fcobs
+    assert [e["ts"] for e in fcobs] == sorted(e["ts"] for e in fcobs)
+    attrib = blob["otherData"]["device_attribution"]
+    assert attrib["merged"] and not attrib["device_track"]
+    # per-round step annotation made it into the span args
+    stepped = [e for e in fcobs
+               if (e.get("args") or {}).get("step") is not None]
+    assert stepped, "no step spans recorded"

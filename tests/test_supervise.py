@@ -33,6 +33,71 @@ def test_retry_until_success(tmp_path):
     assert marker.exists()
 
 
+def test_rotation_chains_telemetry_across_restarts(tmp_path):
+    """A killed-and-restarted child's fcobs JSONL log survives as a
+    rotated chain: attempt 1's log moves to .1 before the relaunch, and
+    obs/export.read_jsonl_chain stitches the fragments back into one
+    cumulative stream (the 13-attempt lfr100k scenario in miniature)."""
+    from fastconsensus_tpu.obs import export as obs_export
+
+    prog = tmp_path / "p.txt"
+    marker = tmp_path / "m"
+    log = tmp_path / "trace.json.jsonl"
+    # the child writes a fresh fcobs-shaped JSONL each attempt ("w" mode,
+    # exactly like cli.py --trace), dies once, succeeds on attempt 2
+    script = (
+        "import json, os, sys\n"
+        f"open({str(prog)!r}, 'a').write('tick')\n"
+        f"attempt = 2 if os.path.exists({str(marker)!r}) else 1\n"
+        f"with open({str(log)!r}, 'w') as fh:\n"
+        "    fh.write(json.dumps({'kind': 'span', 'name': 'round',\n"
+        "        'ph': 'X', 'ts': 10, 'dur': 5, 'a': attempt}) + '\\n')\n"
+        "    fh.write(json.dumps({'kind': 'counters',\n"
+        "        'counters': {'rounds.total': attempt}}) + '\\n')\n"
+        f"if attempt == 1:\n"
+        f"    open({str(marker)!r}, 'w').close()\n"
+        "    sys.exit(3)\n")
+    rc = run_supervised([sys.executable, "-c", script], str(prog),
+                        stall_seconds=30, recover_seconds=0.1,
+                        poll_seconds=0.1, rotate=[str(log)],
+                        log=lambda *a: None)
+    assert rc == 0
+    # the dead attempt's log was rotated, not overwritten
+    assert (tmp_path / "trace.json.jsonl.1").exists()
+    records = obs_export.read_jsonl_chain(str(log))
+    spans = [r for r in records if r["kind"] == "span"]
+    assert [r["attempt"] for r in spans] == [1, 2]
+    assert [r["a"] for r in spans] == [1, 2]
+    # attempt 2's span was rebased past attempt 1's end (15us)
+    assert spans[1]["ts"] >= spans[0]["ts"] + spans[0]["dur"]
+    # the last counters record carries the (checkpoint-restored)
+    # cumulative totals
+    counters = [r for r in records if r["kind"] == "counters"]
+    assert counters[-1]["counters"]["rounds.total"] == 2
+
+
+def test_rotation_cli_flag_parses(tmp_path):
+    """--rotate wires through main() to run_supervised."""
+    from fastconsensus_tpu.utils.supervise import main
+
+    prog = tmp_path / "p.txt"
+    log = tmp_path / "log.jsonl"
+    log.write_text("{}\n")
+    marker = tmp_path / "m"
+    script = (
+        "import os, sys\n"
+        f"open({str(prog)!r}, 'a').write('tick')\n"
+        f"if not os.path.exists({str(marker)!r}):\n"
+        f"    open({str(marker)!r}, 'w').close()\n"
+        "    sys.exit(3)\n")
+    rc = main(["--progress", str(prog), "--stall-seconds", "30",
+               "--recover-seconds", "0.1", "--poll-seconds", "0.1",
+               "--rotate", str(log), "--",
+               sys.executable, "-c", script])
+    assert rc == 0
+    assert (tmp_path / "log.jsonl.1").exists()
+
+
 def test_stall_kill_and_give_up(tmp_path):
     # child never writes progress and sleeps forever -> killed each attempt
     prog = tmp_path / "p.txt"
