@@ -11,7 +11,6 @@ the n_p ensemble runs are a vmapped batch axis (sharded over the device mesh);
 the consensus round is one jitted function built from segment reductions.
 """
 
-from fastconsensus_tpu.graph import GraphSlab, pack_edges, host_edges
 from fastconsensus_tpu.version import __version__
 
 __all__ = ["GraphSlab", "pack_edges", "host_edges", "fast_consensus",
@@ -20,8 +19,16 @@ __all__ = ["GraphSlab", "pack_edges", "host_edges", "fast_consensus",
 
 
 def __getattr__(name):
-    # Lazy top-level API: importing the package must stay cheap (no jax
-    # tracing) for CLI --help and host-only tooling.
+    # Lazy top-level API: importing the package must stay JAX-FREE (not
+    # just cheap) — CLI --help, host-only tooling (obs/history,
+    # bench_report) and the fcserve thin client (cli.py --server via
+    # serve/client.py + utils/io.py) all import under this package and
+    # must not pay (or even require) the jax import.  graph.py imports
+    # jax at module level, so even the slab names resolve lazily here.
+    if name in ("GraphSlab", "pack_edges", "host_edges"):
+        from fastconsensus_tpu import graph
+
+        return getattr(graph, name)
     if name in ("fast_consensus", "run_consensus", "ConsensusConfig"):
         from fastconsensus_tpu import consensus
 

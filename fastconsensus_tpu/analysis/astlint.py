@@ -54,6 +54,19 @@ changes *performance* or *distributions*, not output shapes:
     import time (before backend/mesh configuration) and, captured in a
     kernel, violates the closure rule above.
 
+``mesh-axis``
+    A sharding-annotation axis name — a ``PartitionSpec`` entry
+    (``shard_map`` in/out specs, ``with_sharding_constraint``) or a
+    collective's axis argument (``psum("p")``, ``pmax``,
+    ``all_gather``, ...) — that no mesh in the module declares.  Axis
+    names are stringly-typed: a typo'd axis passes every shape check
+    and fails only at runtime on a real multi-chip mesh (the
+    parallel/sharding.py / ops/sharded_tail.py hazard the ROADMAP
+    names).  The declared set is collected from module-level
+    ``*_AXIS = "name"`` constants and ``Mesh(...)`` axis-name tuples;
+    modules declaring neither are exempt (the rule cannot know their
+    mesh).
+
 All rules support ``# fcheck: ok=<rule>`` suppression pragmas
 (diagnostics.parse_pragmas).
 """
@@ -90,6 +103,13 @@ _TRACED_PREDICATES = {
 }
 _SYNC_CALLS_ATTR = {"item", "block_until_ready"}
 _F64_NAMES = {"float64", "double", "complex128"}
+# lax collectives whose axis argument is a mesh axis NAME; mapped to the
+# positional index that argument takes (axis_name= kwarg also accepted).
+_COLLECTIVE_AXIS_ARG = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "all_gather": 1,
+    "psum_scatter": 1, "all_to_all": 1, "ppermute": 1, "pshuffle": 1,
+    "axis_index": 0, "axis_size": 0,
+}
 
 
 def _scope_nodes(fn: ast.AST):
@@ -216,6 +236,7 @@ class Linter:
                 file=self.filename, line=e.lineno or 0, col=e.offset or 0))
             return self.diags
         self._module_level(tree)
+        self._check_mesh_axes(tree)
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 self._check_function(node)
@@ -246,6 +267,92 @@ class Linter:
                             "device array at import time (and would break "
                             "kernel closures); use a Python scalar or "
                             "build it inside the jitted function")
+
+    # ---------------- mesh-axis ----------------
+
+    def _declared_axes(self, tree: ast.Module
+                       ) -> Tuple[Dict[str, str], Set[str]]:
+        """(axis-constant name -> value, declared axis values).
+
+        Declarations: module-level ``FOO_AXIS = "name"`` string
+        constants (the parallel/sharding.py convention — the literals
+        are part of the mesh contract) and axis-name tuples passed to
+        ``Mesh(...)`` (second positional arg or ``axis_names=``).
+        """
+        consts: Dict[str, str] = {}
+        axes: Set[str] = set()
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name) \
+                    and stmt.targets[0].id.endswith("_AXIS") \
+                    and isinstance(stmt.value, ast.Constant) \
+                    and isinstance(stmt.value.value, str):
+                consts[stmt.targets[0].id] = stmt.value.value
+                axes.add(stmt.value.value)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            _, name = _call_name(node)
+            if name != "Mesh":
+                continue
+            names_arg = node.args[1] if len(node.args) >= 2 else None
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    names_arg = kw.value
+            if isinstance(names_arg, (ast.Tuple, ast.List)):
+                for el in names_arg.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        axes.add(el.value)
+                    elif isinstance(el, ast.Name) and el.id in consts:
+                        axes.add(consts[el.id])
+        return consts, axes
+
+    def _axis_expr(self, expr: Optional[ast.AST], axes: Set[str],
+                   consts: Dict[str, str], where: str) -> None:
+        """Flag a string axis name (or tuple of them) not in ``axes``.
+        Non-literal expressions that cannot be resolved through the
+        module's axis constants are skipped (conservative)."""
+        if expr is None:
+            return
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            for el in expr.elts:
+                self._axis_expr(el, axes, consts, where)
+            return
+        value = None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            value = expr.value
+        elif isinstance(expr, ast.Name) and expr.id in consts:
+            value = consts[expr.id]
+        if value is not None and value not in axes:
+            self._diag(
+                "mesh-axis", expr,
+                f"axis {value!r} in {where} is not declared by any mesh "
+                f"in this module (known axes: {sorted(axes)}); a typo'd "
+                "axis name passes tracing and fails only at runtime on "
+                "a real mesh")
+
+    def _check_mesh_axes(self, tree: ast.Module) -> None:
+        consts, axes = self._declared_axes(tree)
+        if not axes:
+            return  # no mesh contract declared here — nothing to check
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            qual, name = _call_name(node)
+            if name in _COLLECTIVE_AXIS_ARG and qual is not None and \
+                    (qual == "lax" or qual.endswith(".lax")):
+                idx = _COLLECTIVE_AXIS_ARG[name]
+                target = node.args[idx] if len(node.args) > idx else None
+                for kw in node.keywords:
+                    if kw.arg == "axis_name":
+                        target = kw.value
+                self._axis_expr(target, axes, consts, f"lax.{name}")
+            elif name in ("P", "PartitionSpec"):
+                for arg in node.args:
+                    if isinstance(arg, ast.Starred):
+                        continue
+                    self._axis_expr(arg, axes, consts, "PartitionSpec")
 
     # ---------------- per-call rules ----------------
 

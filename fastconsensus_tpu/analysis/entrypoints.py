@@ -184,6 +184,15 @@ def entry_points() -> List[EntryPoint]:
     # merging) but builds no jittable programs, so there is nothing for
     # the jaxpr audit to trace; its host clock reads carry the same
     # sync-in-loop pragma discipline as the tracer.
+    # The fcserve serving layer (serve/) is host-only by the same
+    # reasoning: stdlib HTTP/threading/queue/cache machinery whose only
+    # device contact is DRIVING run_consensus — already audited above
+    # through the engine entry points it reuses (serve/bucketer.py even
+    # canonicalizes slab statics so requests land on those exact audited
+    # shapes).  It registers no entry points; the AST lint walks the
+    # package tree (including serve/), and the server's deliberate host
+    # syncs carry `# fcheck: ok=sync-in-loop` pragmas with reasons
+    # (serve/server.py run_spec's partition readback loop).
     assert available()  # registry import sanity
     return eps
 

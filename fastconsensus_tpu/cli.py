@@ -91,6 +91,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "re-derives partitions from singletons, like the "
                         "reference); warm start is the default and is "
                         "usually several times faster at equal quality")
+    p.add_argument("--server", type=str, default=None, metavar="URL",
+                   help="submit the run to a running fcserve instance "
+                        "(python -m fastconsensus_tpu.serve) instead of "
+                        "executing locally: the warm server reuses "
+                        "compiled executables across requests and answers "
+                        "repeats from its result cache. Outputs are "
+                        "written locally as usual; engine-local flags "
+                        "(--checkpoint/--resume/--detect-cache/--trace/"
+                        "--trace-jsonl/--profile-dir/--capacity) are "
+                        "ignored")
     p.add_argument("--out-dir", type=str, default=".",
                    help="directory to create output trees in (default: .)")
     p.add_argument("--quiet", action="store_true",
@@ -147,6 +157,62 @@ def check_arguments(args) -> Optional[str]:
     return None
 
 
+def _run_remote(args) -> int:
+    """``--server``: submit to a running fcserve instance and write the
+    reference-layout outputs locally (jax-free client path)."""
+    import numpy as np
+
+    from fastconsensus_tpu.serve.client import (Backpressure, JobFailed,
+                                                ServeClient, ServeError)
+    from fastconsensus_tpu.utils.io import read_edgelist, write_partition_dirs
+
+    try:
+        edges, _, original_ids = read_edgelist(args.f)
+    except (OSError, ValueError) as e:
+        print(f"error reading {args.f}: {e}", file=sys.stderr)
+        return 2
+    client = ServeClient(args.server)
+    t0 = time.perf_counter()
+    try:
+        sub = client.submit(edges=edges, n_nodes=len(original_ids),
+                            algorithm=args.alg, n_p=args.n_p, tau=args.tau,
+                            delta=args.delta, max_rounds=args.max_rounds,
+                            seed=args.seed, gamma=args.gamma,
+                            auto_grow=not args.no_grow,
+                            warm_start=not args.cold_detect,
+                            closure_sampler=args.closure_sampler,
+                            **({"align_frac": args.align_frac}
+                               if args.align_frac is not None else {}),
+                            **({"closure_tau": args.closure_tau}
+                               if args.closure_tau is not None else {}))
+        result = client.wait(sub["job_id"])
+    except Backpressure as e:
+        print(f"error: server overloaded ({e.payload.get('error')}); "
+              f"retry later", file=sys.stderr)
+        return 3
+    except (JobFailed, ServeError, OSError, TimeoutError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    elapsed = time.perf_counter() - t0
+    partitions = [np.asarray(p, dtype=np.int32)
+                  for p in result["partitions"]]
+    if not args.quiet:
+        state = "converged" if result.get("converged") else \
+            f"max_rounds={args.max_rounds} reached"
+        src = "cache" if result.get("cached") else \
+            f"bucket {result.get('bucket', {}).get('key')}"
+        print(f"{state} after {result.get('rounds')} round(s) in "
+              f"{elapsed:.2f}s (served from {src})", file=sys.stderr)
+    suffix = f"t{args.tau}_d{args.delta}_np{args.n_p}"
+    out_dir = os.path.join(args.out_dir, f"out_partitions_{suffix}")
+    mem_dir = os.path.join(args.out_dir, f"memberships_{suffix}")
+    write_partition_dirs(out_dir, mem_dir, partitions, original_ids)
+    if not args.quiet:
+        print(f"wrote {len(partitions)} partitions to {out_dir} "
+              f"and {mem_dir}", file=sys.stderr)
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.tau is None:
@@ -155,6 +221,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if err:
         print(err, file=sys.stderr)
         return 2
+
+    if args.server is not None:
+        # Thin-client path: no jax import at all — the resident server
+        # owns the engine (serve/); this process only reads the file,
+        # submits, polls, and writes the reference-layout outputs.
+        return _run_remote(args)
 
     from fastconsensus_tpu.utils.env import setup_compile_cache
 

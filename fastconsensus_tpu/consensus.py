@@ -266,7 +266,8 @@ def run_consensus(slab: GraphSlab,
                   checkpoint_every: int = 1,
                   resume: bool = False,
                   on_round=None,
-                  detect_cache_dir: Optional[str] = None) -> ConsensusResult:
+                  detect_cache_dir: Optional[str] = None,
+                  n_closure: Optional[int] = None) -> ConsensusResult:
     """Host-side driver: iterate jitted rounds to delta-convergence.
 
     With ``mesh`` (a ``jax.sharding.Mesh`` from parallel/sharding.py) the
@@ -287,6 +288,14 @@ def run_consensus(slab: GraphSlab,
     restarted process (same config/seed, ``resume=True`` + checkpoint for
     the round state) re-detects only unfinished chunks.  Pair with
     ``checkpoint_path``; clean the directory between unrelated runs.
+
+    ``n_closure``: override for the per-round wedge-sample count L
+    (default: the slab's alive edge count, the reference's ``L = |E0|``,
+    fc:175).  L is a *static* shape of every jitted round executable, so
+    the serving layer (serve/bucketer.py) passes the bucket-canonical
+    edge class here — distinct graphs padded into one size bucket then
+    share executables instead of each compiling its own round over its
+    own exact edge count.
     """
     if key is None:
         key = jax.random.key(config.seed)
@@ -296,7 +305,9 @@ def run_consensus(slab: GraphSlab,
     # is a handful of attribute checks (the <2% bench contract, ISSUE 2).
     tracer = get_tracer()
     obs_reg = obs_counters.get_registry()
-    n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
+    if n_closure is None:
+        n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
+    n_closure = int(n_closure)
     if config.closure_sampler not in ("auto", "csr", "scatter"):
         raise ValueError(
             f"closure_sampler={config.closure_sampler!r}: expected "
@@ -436,12 +447,22 @@ def run_consensus(slab: GraphSlab,
         # loop.  Block size targets ~15 s per call; 1 disables fusion.
         fb = 1
         if not sp and checkpoint_path is None and mesh is None:
-            round_s = (measured_member_s * config.n_p
-                       if measured_member_s else
-                       sizing.est_member_seconds(slab, detect,
-                                                 config.algorithm)
-                       * config.n_p)
-            fb = max(1, min(8, int(15.0 / max(round_s, 1e-9))))
+            fb_env = env_int("FCTPU_ROUNDS_BLOCK")
+            if fb_env is not None:
+                # pinned block size: the block count is part of the
+                # compiled executable's shape, and rate-adaptive fusion
+                # re-sizes (recompiles) when measurements drift — fine
+                # for one long run, a compile hazard for a resident
+                # server cycling heterogeneous requests through shared
+                # bucket executables (serve/server.py pins this)
+                fb = max(1, min(8, fb_env))
+            else:
+                round_s = (measured_member_s * config.n_p
+                           if measured_member_s else
+                           sizing.est_member_seconds(slab, detect,
+                                                     config.algorithm)
+                           * config.n_p)
+                fb = max(1, min(8, int(15.0 / max(round_s, 1e-9))))
         return m, sp, fb
 
     def setup_executables() -> None:

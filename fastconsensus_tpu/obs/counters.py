@@ -49,6 +49,7 @@ class ObsRegistry:
         self._counters: Dict[str, int] = {}
         self._gauges: Dict[str, float] = {}
         self._series: Dict[str, List[float]] = {}
+        self._series_limit: Optional[int] = None
 
     # -- writes ------------------------------------------------------
 
@@ -62,7 +63,28 @@ class ObsRegistry:
 
     def observe(self, name: str, value: float) -> None:
         with self._lock:
-            self._series.setdefault(name, []).append(float(value))
+            series = self._series.setdefault(name, [])
+            series.append(float(value))
+            if self._series_limit and len(series) > self._series_limit:
+                del series[: len(series) - self._series_limit]
+
+    def set_series_limit(self, limit: Optional[int]) -> None:
+        """Bound every series to its most recent ``limit`` samples.
+
+        One-shot consumers (CLI, bench) keep the default ``None`` — full
+        history, whole-run percentiles.  A RESIDENT process must bound
+        this: the serving layer observes per-job/per-round latencies
+        forever, and unbounded sample lists are a slow memory leak
+        (serve/server.py sets a window at start; summaries then describe
+        the recent window, which is what a serving dashboard wants
+        anyway).  Applies retroactively to existing series.
+        """
+        with self._lock:
+            self._series_limit = None if limit is None \
+                else max(1, int(limit))
+            if self._series_limit:
+                for series in self._series.values():
+                    del series[: len(series) - self._series_limit]
 
     def reset(self) -> None:
         with self._lock:

@@ -218,7 +218,25 @@ def chain_segments(path: str) -> List[str]:
     return out
 
 
-def read_jsonl_chain(path: str) -> List[dict]:
+def profiler_sidecar_path(path: str, segment: str) -> Optional[str]:
+    """The Perfetto companion of one JSONL chain segment, if derivable.
+
+    ``cli.py --trace PATH`` writes the Perfetto blob at ``PATH`` and the
+    streaming event log at ``PATH.jsonl``; ``supervise --rotate`` moves
+    both with lockstep numeric suffixes (both start unrotated, both
+    rotate in the same :func:`~fastconsensus_tpu.utils.supervise
+    .rotate_for_retry` call), so segment ``PATH.jsonl.k`` pairs with
+    ``PATH.k`` and the live ``PATH.jsonl`` with ``PATH``.  Returns None
+    when ``path`` does not end in ``.jsonl`` (no naming convention to
+    lean on).
+    """
+    if not path.endswith(".jsonl"):
+        return None
+    base = path[: -len(".jsonl")]
+    return base + segment[len(path):]
+
+
+def read_jsonl_chain(path: str, with_profiler: bool = False) -> List[dict]:
     """One coherent event stream from a rotated JSONL chain.
 
     Concatenates every segment of :func:`chain_segments` in order; each
@@ -229,6 +247,20 @@ def read_jsonl_chain(path: str) -> List[dict]:
     records pass through untouched: with checkpointed counter restore
     (obs/counters.restore_counters) the LAST counters record is already
     the run's cumulative truth.
+
+    ``with_profiler``: also pick up each attempt's rotated *Perfetto*
+    sidecar (:func:`profiler_sidecar_path` — the ``--trace`` blob the
+    same rotation chained next to the JSONL) and splice its
+    profiler-originated events in as ``{"kind": "profiler", "attempt":
+    k, ...}`` records: a supervised ``--trace --profile-dir`` run's
+    per-attempt device timelines read back as one attempt-tagged
+    stream.  Only complete/instant events ride along — metadata rows
+    ("M") and ``cat == "fcobs"`` spans are skipped (the latter are
+    already in the JSONL); timestamps are rebased by the same
+    per-attempt offset as the spans (the merge already aligned profiler
+    events to that attempt's fcobs clock — obs/device.py).  A missing
+    or unparsable sidecar contributes nothing rather than failing the
+    read.
     """
     records: List[dict] = []
     offset = 0
@@ -246,8 +278,36 @@ def read_jsonl_chain(path: str) -> List[dict]:
                                   rec["ts"] + rec.get("dur", 0))
                     rec["ts"] = rec["ts"] + offset
                 records.append(rec)
+        if with_profiler:
+            records.extend(
+                _profiler_records(path, seg, attempt, offset))
         offset += seg_end
     return records
+
+
+def _profiler_records(path: str, segment: str, attempt: int,
+                      offset: int) -> List[dict]:
+    """Profiler events of one segment's Perfetto sidecar (see
+    read_jsonl_chain); empty on any miss — chain reading must never
+    fail on a half-written attempt."""
+    side = profiler_sidecar_path(path, segment)
+    if side is None or not os.path.exists(side):
+        return []
+    try:
+        with open(side, encoding="utf-8") as fh:
+            blob = json.load(fh)
+        events = blob.get("traceEvents") or []
+    except (OSError, ValueError):
+        return []
+    out: List[dict] = []
+    for ev in events:
+        if ev.get("ph") not in ("X", "i") or ev.get("cat") == "fcobs":
+            continue
+        rec = {"kind": "profiler", "attempt": attempt, **ev}
+        if "ts" in rec:
+            rec["ts"] = rec["ts"] + offset
+        out.append(rec)
+    return out
 
 
 def summary_table(events: List[dict],

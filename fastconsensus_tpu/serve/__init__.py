@@ -1,0 +1,56 @@
+"""fcserve: the request-serving layer over the consensus engine.
+
+Turns the one-shot engine (cli.py / bench.py pay graph load + executable
+warm-up per invocation and throw the compiled state away) into a
+long-lived service that amortizes everything amortizable:
+
+* **serve/bucketer.py** — shape buckets: incoming graphs pad onto a
+  small ``{2^k, 3*2^k}`` ladder of canonical (n_nodes, n_edges) classes
+  (sizing.grid_up) with every content-derived static slab field
+  canonicalized, so distinct graphs in one bucket reuse the same jitted
+  executables — warm-bucket requests compile zero times.
+* **serve/cache.py** — content-addressed result cache (LRU + TTL):
+  identical (graph, config) work — keyed by serve/jobs.py's canonical
+  content hash — is answered from memory, no device time at all.
+* **serve/queue.py** — bounded thread-safe priority queue with explicit
+  admission control: overload is rejected with backpressure (HTTP 429),
+  never absorbed into unbounded growth.
+* **serve/jobs.py** — job spec / states / priorities + the content hash.
+* **serve/server.py** — the service core (single device-driving worker)
+  and the stdlib HTTP front end: ``POST /submit``, ``GET /status/<id>``,
+  ``/result/<id>``, ``/healthz``, ``/metricsz`` (the fcobs registry —
+  cache hit rate, per-job compiles, queue depth — as JSON).
+* **serve/client.py** — stdlib urllib client (``cli.py --server`` uses
+  it to submit without importing jax).
+
+Run one: ``python -m fastconsensus_tpu.serve --port 8765``; SIGTERM
+drains gracefully (finish admitted work, export the server's fcobs
+trace with ``--trace-dir``, exit 0).  See README "Serving".
+"""
+
+# Lazy re-exports (PEP 562), mirroring the package root: importing
+# fastconsensus_tpu.serve.client (the THIN-CLIENT path — cli.py
+# --server) must stay jax-free, and eager submodule imports here would
+# pull bucketer -> graph -> jax into every client process.
+_EXPORTS = {
+    "Bucket": "bucketer", "BucketTooLarge": "bucketer",
+    "bucket_for": "bucketer", "pad_to_bucket": "bucketer",
+    "ResultCache": "cache",
+    "Job": "jobs", "JobSpec": "jobs", "content_hash": "jobs",
+    "AdmissionQueue": "queue", "QueueClosed": "queue",
+    "QueueFull": "queue",
+    "ConsensusService": "server", "GraphTooLarge": "server",
+    "ServeConfig": "server", "make_http_server": "server",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(
+            f"fastconsensus_tpu.serve.{_EXPORTS[name]}")
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,124 @@
+"""CLI: ``python -m fastconsensus_tpu.serve`` — run one fcserve instance.
+
+Binds the stdlib HTTP front end, launches the device worker, and waits
+for SIGTERM/SIGINT; on signal the server **drains**: admissions close
+(submits answer 503), every already-admitted job finishes, the server's
+own fcobs trace artifacts are exported (``--trace-dir``), and the
+process exits 0.  A non-zero exit means the drain timed out with work
+still in flight.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from fastconsensus_tpu.serve.server import ServeConfig
+
+    d = ServeConfig()
+    p = argparse.ArgumentParser(
+        prog="python -m fastconsensus_tpu.serve",
+        description="fcserve: long-lived consensus-clustering service "
+                    "(shape-bucketed batching, content-addressed result "
+                    "cache, admission control).")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (default: loopback only)")
+    p.add_argument("--port", type=int, default=8765,
+                   help="TCP port (0 picks a free one; default 8765)")
+    p.add_argument("--queue-depth", type=int, default=d.queue_depth,
+                   help="admission bound: jobs beyond this are rejected "
+                        f"with HTTP 429 (default {d.queue_depth})")
+    p.add_argument("--cache-entries", type=int, default=d.cache_entries,
+                   help="result-cache LRU capacity "
+                        f"(default {d.cache_entries})")
+    p.add_argument("--cache-ttl", type=float, default=d.cache_ttl_s,
+                   metavar="SECONDS",
+                   help="result-cache TTL "
+                        f"(default {d.cache_ttl_s:.0f}s)")
+    p.add_argument("--max-nodes", type=int, default=d.max_nodes,
+                   help="largest admissible graph, nodes (HTTP 413 above)")
+    p.add_argument("--max-edges", type=int, default=d.max_edges,
+                   help="largest admissible graph, edges (HTTP 413 above)")
+    p.add_argument("--drain-timeout", type=float, default=d.drain_timeout_s,
+                   metavar="SECONDS",
+                   help="max seconds to finish admitted work on SIGTERM "
+                        f"(default {d.drain_timeout_s:.0f})")
+    p.add_argument("--no-pin-sizing", action="store_true",
+                   help="let the engine re-size executables adaptively "
+                        "per request (default: pinned — stable bucket "
+                        "executables; see serve/server.py)")
+    p.add_argument("--trace-dir", type=str, default=None, metavar="DIR",
+                   help="export the server's fcobs trace artifacts "
+                        "(fcserve_trace.json + .jsonl) to DIR on drain")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress startup/drain log lines")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    # imports deferred so -h never pays the jax/engine import
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig,
+                                                make_http_server)
+    from fastconsensus_tpu.utils.env import setup_compile_cache
+
+    setup_compile_cache()
+
+    def say(msg: str) -> None:
+        if not args.quiet:
+            print(f"[fcserve] {msg}", file=sys.stderr, flush=True)
+
+    logging.basicConfig(level=logging.WARNING)
+    cfg = ServeConfig(queue_depth=args.queue_depth,
+                      cache_entries=args.cache_entries,
+                      cache_ttl_s=args.cache_ttl,
+                      max_nodes=args.max_nodes,
+                      max_edges=args.max_edges,
+                      drain_timeout_s=args.drain_timeout,
+                      pin_sizing=not args.no_pin_sizing,
+                      trace_dir=args.trace_dir)
+    service = ConsensusService(cfg).start()
+    try:
+        httpd = make_http_server(service, args.host, args.port)
+    except OSError as e:
+        print(f"error: cannot bind {args.host}:{args.port}: {e}",
+              file=sys.stderr)
+        return 2
+    host, port = httpd.server_address[:2]
+    say(f"listening on http://{host}:{port} "
+        f"(queue depth {cfg.queue_depth}, cache {cfg.cache_entries} "
+        f"entries / {cfg.cache_ttl_s:.0f}s TTL)")
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame) -> None:
+        say(f"signal {signum}: draining")
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    http_thread = threading.Thread(target=httpd.serve_forever,
+                                   name="fcserve-http", daemon=True)
+    http_thread.start()
+    stop.wait()
+    # Drain order: stop admissions FIRST (in-flight handler threads get
+    # 503 from the closed queue), then stop the listener, then finish
+    # every admitted job.
+    service.begin_drain()
+    httpd.shutdown()
+    ok = service.drain(cfg.drain_timeout_s)
+    httpd.server_close()
+    say("drained cleanly" if ok
+        else f"drain timed out after {cfg.drain_timeout_s:.0f}s")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

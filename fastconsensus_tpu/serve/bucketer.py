@@ -1,0 +1,144 @@
+"""fcserve shape buckets: pad incoming graphs onto a canonical ladder.
+
+Every static shape and static slab field is part of a jitted
+executable's cache key (graph.GraphSlab metadata, engine._jitted_round
+arguments), so a naive server would compile a fresh multi-minute
+executable set for every distinct (n_nodes, n_edges) it ever sees.  This
+module folds the infinite input space onto a small ladder of **size
+classes** — the ``{2^k, 3*2^k}`` grid from :func:`sizing.grid_up`, the
+same quantization the engine already applies to detect-call member
+counts — and pads each graph to its class:
+
+* ``n_class``  — node count padded up (extra nodes are isolated: they
+  contribute no edges, no strength, and fall out as singleton
+  communities the server slices off the returned partitions);
+* ``e_class``  — canonical (deduped) edge count padded up; it sizes the
+  slab capacity exactly as ``pack_edges`` would (``2*E + 16`` closure
+  headroom) and serves as the bucket-canonical wedge-sample count L
+  (``run_consensus(n_closure=...)``).
+
+Crucially, the *content-derived* static slab fields are *canonicalized
+away*: ``d_cap``/``d_hyb``/``hub_cap`` are pinned to 0 (two same-bucket
+graphs with different degree histograms would otherwise derive different
+dense/hybrid row widths — different static fields, different
+executables) and ``agg_cap``/``cap_hint`` are pure functions of the
+bucket.  Detection therefore takes the matmul path for buckets up to
+``MATMUL_MAX_N`` nodes and the hash path above it — both
+content-shape-independent.  The cost is forgoing the dense/hybrid
+lowerings; the win is the serving contract: **any two graphs in one
+bucket run the same executables, so every request after the bucket's
+first compiles nothing** (asserted with ``analysis.CompileGuard`` in
+tests/test_serve.py and the CI smoke).
+
+Padding changes results only through the sample-count semantics above
+(documented deviation: a served run of graph G may differ from a
+one-shot ``cli.py`` run of G in tie-degenerate choices), but it is
+deterministic: same graph + same config -> same bucket -> same
+partitions, which is what the content-addressed cache requires.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from fastconsensus_tpu import sizing
+from fastconsensus_tpu.graph import GraphSlab, derive_agg_sizing, pack_edges
+
+# Floors keep tiny interactive graphs (karate-sized probes) in ONE
+# bucket instead of one per size, at negligible padding cost.
+MIN_NODE_CLASS = 64
+MIN_EDGE_CLASS = 64
+
+
+class BucketTooLarge(ValueError):
+    """Admission refused: the graph exceeds the configured ladder top
+    (HTTP 413 — oversized payloads are rejected, not queued)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One rung of the ladder: canonical (node, edge) size class."""
+
+    n_class: int
+    e_class: int
+
+    @property
+    def capacity(self) -> int:
+        """Slab capacity: pack_edges' default headroom at the class."""
+        return 2 * self.e_class + 16
+
+    @property
+    def agg_cap(self) -> int:
+        return derive_agg_sizing(self.e_class)
+
+    @property
+    def n_closure(self) -> int:
+        """Bucket-canonical wedge-sample count L (run_consensus)."""
+        return self.e_class
+
+    def key(self) -> str:
+        return f"n{self.n_class}_e{self.e_class}"
+
+    def describe(self) -> dict:
+        return {"n_class": self.n_class, "e_class": self.e_class,
+                "capacity": self.capacity, "key": self.key()}
+
+
+def bucket_for(n_nodes: int, n_edges: int,
+               max_nodes: Optional[int] = None,
+               max_edges: Optional[int] = None) -> Bucket:
+    """The bucket serving a graph of ``n_nodes`` / ``n_edges``
+    (canonical edge count), or raise :class:`BucketTooLarge`."""
+    if n_nodes < 1 or n_edges < 1:
+        raise ValueError(
+            f"graph must have >= 1 node and >= 1 edge, got "
+            f"n_nodes={n_nodes}, n_edges={n_edges}")
+    if max_nodes is not None and n_nodes > max_nodes:
+        raise BucketTooLarge(
+            f"graph has {n_nodes} nodes; this server admits at most "
+            f"{max_nodes}")
+    if max_edges is not None and n_edges > max_edges:
+        raise BucketTooLarge(
+            f"graph has {n_edges} edges; this server admits at most "
+            f"{max_edges}")
+    return Bucket(n_class=sizing.grid_up(n_nodes, MIN_NODE_CLASS),
+                  e_class=sizing.grid_up(n_edges, MIN_EDGE_CLASS))
+
+
+def pad_to_bucket(edges: np.ndarray, n_nodes: int,
+                  weights: Optional[np.ndarray] = None,
+                  max_nodes: Optional[int] = None,
+                  max_edges: Optional[int] = None,
+                  canonical: Optional[Tuple[np.ndarray, np.ndarray,
+                                            Optional[np.ndarray]]] = None
+                  ) -> Tuple[GraphSlab, Bucket]:
+    """Pack a graph into its bucket's canonical slab shape.
+
+    The returned slab's every static field is a pure function of the
+    BUCKET (see module docstring), so jit caches key identically for all
+    graphs the bucket serves.  Alive-edge content still belongs to the
+    input graph — padding adds dead slots and isolated nodes only.
+
+    ``canonical``: an already-computed ``jobs.canonical_edges`` result
+    for these exact inputs (``JobSpec.canonical()`` memoizes it at
+    hash time), skipping a second sort/dedupe pass here.
+    """
+    if canonical is None:
+        from fastconsensus_tpu.serve.jobs import canonical_edges
+
+        canonical = canonical_edges(edges, n_nodes, weights)
+    u, v, w = canonical
+    bucket = bucket_for(n_nodes, int(u.shape[0]),
+                        max_nodes=max_nodes, max_edges=max_edges)
+    slab = pack_edges(np.stack([u, v], axis=1), bucket.n_class,
+                      weights=w, capacity=bucket.capacity)
+    # Canonicalize the content-derived statics (pack_edges set them from
+    # THIS graph's degree histogram; the bucket contract requires them
+    # identical across the bucket).
+    slab = dataclasses.replace(
+        slab, d_cap=0, d_hyb=0, hub_cap=0,
+        cap_hint=bucket.capacity, agg_cap=bucket.agg_cap)
+    return slab, bucket

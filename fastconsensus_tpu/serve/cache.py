@@ -1,0 +1,98 @@
+"""fcserve result cache: content-addressed, LRU + TTL bounded.
+
+Keyed by :func:`serve.jobs.content_hash` — the canonical-graph + config
+digest — so a resubmission of the same work (any edge order, any client)
+is answered from memory: no queue slot, no device time, no detect spans.
+Consensus is deterministic per (graph, config, seed), which is what
+makes caching *results* (not just executables) sound.
+
+Two bounds, both mandatory (a serving cache that only ever grows is a
+slow OOM):
+
+* **LRU capacity** — at most ``max_entries`` results resident; inserts
+  beyond it evict the least-recently-hit entry;
+* **TTL** — entries older than ``ttl_seconds`` answer nothing and are
+  dropped on touch (long-lived servers should not serve arbitrarily
+  stale artifacts once operators rotate configs/data around them).
+
+Every outcome counts itself in the fcobs registry
+(``serve.cache.{hit,miss,insert,evict_lru,expired}`` + the
+``serve.cache.entries`` gauge), so ``/metricsz`` exposes hit rate
+directly.  The clock is injectable for deterministic TTL tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Optional, Tuple
+
+from fastconsensus_tpu.obs import counters as obs_counters
+
+
+class ResultCache:
+    """Thread-safe LRU+TTL map of content hash -> result payload."""
+
+    def __init__(self, max_entries: int = 256,
+                 ttl_seconds: float = 3600.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if max_entries < 1:
+            raise ValueError(f"max_entries must be >= 1, got {max_entries}")
+        self.max_entries = int(max_entries)
+        self.ttl_seconds = float(ttl_seconds)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> (stored_at, value); OrderedDict end = most recent
+        self._entries: "OrderedDict[str, Tuple[float, Any]]" = OrderedDict()
+        self._reg = obs_counters.get_registry()
+
+    def get(self, key: str, count_miss: bool = True) -> Optional[Any]:
+        """The cached result, or None (counts hit/miss/expired).
+
+        ``count_miss=False`` is for RE-checks of one admission (the
+        worker re-probes right before running in case an identical
+        queued job completed meanwhile — serve/server.py): a hit there
+        is a genuine serve and always counts, but recounting the miss
+        would double it per computed job and halve the hit rate an
+        operator reads off ``/metricsz``.
+        """
+        now = self._clock()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                if count_miss:
+                    self._reg.inc("serve.cache.miss")
+                return None
+            stored_at, value = entry
+            if now - stored_at > self.ttl_seconds:
+                del self._entries[key]
+                self._reg.inc("serve.cache.expired")
+                if count_miss:
+                    self._reg.inc("serve.cache.miss")
+                self._reg.gauge("serve.cache.entries", len(self._entries))
+                return None
+            # fcheck: ok=key-reuse (this `key` is the content-hash
+            # cache-key STRING, not a PRNG key — the name-based
+            # heuristic misfires; strings have no consumption semantics)
+            self._entries.move_to_end(key)
+            self._reg.inc("serve.cache.hit")
+            return value
+
+    def put(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._entries[key] = (self._clock(), value)
+            self._entries.move_to_end(key)
+            self._reg.inc("serve.cache.insert")
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self._reg.inc("serve.cache.evict_lru")
+            self._reg.gauge("serve.cache.entries", len(self._entries))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def keys(self) -> list:
+        with self._lock:
+            return list(self._entries)

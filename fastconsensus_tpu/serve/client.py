@@ -1,0 +1,130 @@
+"""fcserve client: a stdlib (urllib) wrapper over the HTTP endpoints.
+
+Deliberately jax-free and numpy-optional at import time, so a thin
+front-end process (``cli.py --server``) can submit work without paying
+the engine's import cost — the whole point of keeping one warm serving
+process is that *clients* stay cheap.
+
+Backpressure is surfaced as a typed exception (:class:`Backpressure`,
+HTTP 429) rather than a generic error: callers are expected to catch it
+and retry-with-delay / shed load — that contract is what keeps an
+overloaded server answering instead of queueing itself to death
+(serve/queue.py module notes).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+
+class ServeError(RuntimeError):
+    """Non-2xx response; carries the HTTP status and decoded payload."""
+
+    def __init__(self, status: int, payload: Dict[str, Any]) -> None:
+        super().__init__(
+            f"fcserve HTTP {status}: {payload.get('error', payload)}")
+        self.status = status
+        self.payload = payload
+
+
+class Backpressure(ServeError):
+    """HTTP 429: the admission queue is full — retry later."""
+
+
+class JobFailed(ServeError):
+    """The job ran and failed server-side (HTTP 500 on /result)."""
+
+
+class ServeClient:
+    """Talk to one fcserve instance at ``base_url``."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # -- transport ---------------------------------------------------
+
+    def _request(self, path: str,
+                 payload: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+        url = self.base_url + path
+        data = None
+        headers = {"Accept": "application/json"}
+        if payload is not None:
+            data = json.dumps(payload).encode("utf-8")
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(url, data=data, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read() or b"{}")
+            except ValueError:
+                body = {"error": str(e)}
+            if e.code == 429:
+                raise Backpressure(e.code, body) from None
+            if e.code == 500 and path.startswith("/result/"):
+                raise JobFailed(e.code, body) from None
+            raise ServeError(e.code, body) from None
+
+    # -- endpoints ---------------------------------------------------
+
+    def submit(self, edges=None, n_nodes: Optional[int] = None,
+               edgelist: Optional[str] = None,
+               priority=None, **config) -> Dict[str, Any]:
+        """POST /submit.  ``edges`` is a list of ``[u, v]`` pairs (or a
+        numpy array — ``.tolist()`` is applied); ``config`` fields are
+        the ConsensusConfig subset the server accepts (algorithm, n_p,
+        tau, delta, max_rounds, seed, gamma, ...)."""
+        payload: Dict[str, Any] = dict(config)
+        if edgelist is not None:
+            payload["edgelist"] = edgelist
+        if edges is not None:
+            payload["edges"] = edges.tolist() if hasattr(edges, "tolist") \
+                else list(edges)
+        if n_nodes is not None:
+            payload["n_nodes"] = int(n_nodes)
+        if priority is not None:
+            payload["priority"] = priority
+        return self._request("/submit", payload)
+
+    def status(self, job_id: str) -> Dict[str, Any]:
+        return self._request(f"/status/{job_id}")
+
+    def result(self, job_id: str) -> Dict[str, Any]:
+        """GET /result/<id>; the payload includes ``state`` while the
+        job is still pending (HTTP 202)."""
+        return self._request(f"/result/{job_id}")
+
+    def healthz(self) -> Dict[str, Any]:
+        return self._request("/healthz")
+
+    def metricsz(self) -> Dict[str, Any]:
+        return self._request("/metricsz")
+
+    def wait(self, job_id: str, timeout: float = 300.0,
+             poll_s: float = 0.2) -> Dict[str, Any]:
+        """Poll until the job finishes; returns the result payload.
+        Raises :class:`JobFailed` on server-side failure and
+        TimeoutError when ``timeout`` elapses first."""
+        deadline = time.monotonic() + timeout
+        while True:
+            res = self.result(job_id)
+            if "partitions" in res:
+                return res
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"job {job_id} still {res.get('state')!r} after "
+                    f"{timeout:.0f}s")
+            time.sleep(poll_s)
+
+    def run(self, edges, n_nodes: Optional[int] = None,
+            timeout: float = 300.0, **config) -> Dict[str, Any]:
+        """submit + wait in one call."""
+        sub = self.submit(edges=edges, n_nodes=n_nodes, **config)
+        return self.wait(sub["job_id"], timeout=timeout)

@@ -1,0 +1,201 @@
+"""fcserve job model: specs, states, priorities, content addressing.
+
+A job is one consensus request — a graph plus a :class:`ConsensusConfig`
+— flowing through the service's queue (serve/queue.py) into the worker
+loop (serve/server.py).  Two identity notions coexist deliberately:
+
+* the **job id** (``Job.job_id``) names one *submission* — every submit
+  gets a fresh one, it is what ``/status`` and ``/result`` key on;
+* the **content hash** (:func:`content_hash`) names the *work*: a
+  deterministic SHA-256 over the canonicalized graph bytes and every
+  result-relevant config field.  It is the key of the result cache
+  (serve/cache.py), so resubmitting the same graph+config — regardless
+  of edge order, duplicate edges, or which client sent it — is answered
+  without touching the device.
+
+Canonicalization mirrors ``graph.pack_edges`` (canonical ``src < dst``
+orientation, self-loops dropped, duplicates merged keeping the first
+weight) and then *sorts by edge key*, so the hash is invariant to input
+edge order — the property that makes it content addressing rather than
+payload addressing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from fastconsensus_tpu.consensus import ConsensusConfig
+
+# Smaller pops first (serve/queue.py is a min-heap on priority).
+PRIORITY_INTERACTIVE = 0
+PRIORITY_NORMAL = 1
+PRIORITY_BATCH = 2
+PRIORITY_NAMES = {
+    "interactive": PRIORITY_INTERACTIVE,
+    "normal": PRIORITY_NORMAL,
+    "batch": PRIORITY_BATCH,
+}
+
+# Job lifecycle.  There is deliberately no "rejected" state: admission
+# control (queue full, graph too large, draining) refuses the submission
+# before a Job exists — backpressure is an error the client sees, never
+# unbounded queue growth (serve/queue.py module notes).
+STATE_QUEUED = "queued"
+STATE_RUNNING = "running"
+STATE_DONE = "done"
+STATE_FAILED = "failed"
+STATES = (STATE_QUEUED, STATE_RUNNING, STATE_DONE, STATE_FAILED)
+
+_HASH_VERSION = b"fcserve-v1"
+_job_seq = itertools.count(1)
+
+
+def canonical_edges(edges: np.ndarray, n_nodes: int,
+                    weights: Optional[np.ndarray] = None
+                    ) -> Tuple[np.ndarray, np.ndarray,
+                               Optional[np.ndarray]]:
+    """Canonical ``(u, v, w)`` in ascending edge-key order.
+
+    Same dedup semantics as ``graph.pack_edges`` (src < dst, self-loops
+    dropped, first weight wins on duplicates), then sorted by
+    ``u * n_nodes + v`` so the result — and therefore the content hash —
+    does not depend on input edge order.
+    """
+    edges = np.asarray(edges, dtype=np.int64).reshape(-1, 2)
+    if weights is not None:
+        weights = np.asarray(weights, dtype=np.float32)
+    u = np.minimum(edges[:, 0], edges[:, 1])
+    v = np.maximum(edges[:, 0], edges[:, 1])
+    keep = u != v
+    u, v = u[keep], v[keep]
+    if weights is not None:
+        weights = weights[keep]
+    key = u * np.int64(n_nodes) + v
+    _, first = np.unique(key, return_index=True)
+    first.sort()
+    u, v, key = u[first], v[first], key[first]
+    if weights is not None:
+        weights = weights[first]
+    order = np.argsort(key, kind="stable")
+    return (u[order], v[order],
+            None if weights is None else weights[order])
+
+
+def content_hash(edges: np.ndarray, n_nodes: int,
+                 config: ConsensusConfig,
+                 weights: Optional[np.ndarray] = None) -> str:
+    """Deterministic SHA-256 of (canonical graph bytes, config)."""
+    return hash_canonical(canonical_edges(edges, n_nodes, weights),
+                          n_nodes, config)
+
+
+def hash_canonical(canonical: Tuple[np.ndarray, np.ndarray,
+                                    Optional[np.ndarray]],
+                   n_nodes: int, config: ConsensusConfig) -> str:
+    """:func:`content_hash` over an already-canonicalized ``(u, v, w)``
+    (JobSpec memoizes the canonicalization — at the serving limit of
+    millions of edges the sort/dedupe pass is worth doing once, not
+    once for the hash and again for the bucket pack)."""
+    u, v, w = canonical
+    h = hashlib.sha256()
+    h.update(_HASH_VERSION)
+    h.update(int(n_nodes).to_bytes(8, "little"))
+    h.update(np.ascontiguousarray(u, dtype="<i8").tobytes())
+    h.update(np.ascontiguousarray(v, dtype="<i8").tobytes())
+    if w is not None and not np.all(w == 1.0):
+        h.update(np.ascontiguousarray(w, dtype="<f4").tobytes())
+    # every ConsensusConfig field is result-relevant (the checkpoint
+    # fingerprints in consensus.py guard the same set); astuple keeps
+    # this in lockstep with future config fields automatically
+    h.update(repr(dataclasses.astuple(config)).encode())
+    return h.hexdigest()
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """One consensus request: compact 0-based edges + run config."""
+
+    edges: np.ndarray            # int64[E, 2], compact 0-based ids
+    n_nodes: int
+    config: ConsensusConfig
+    weights: Optional[np.ndarray] = None
+    priority: int = PRIORITY_NORMAL
+
+    def n_edges_raw(self) -> int:
+        """Raw (pre-dedupe) edge count — the cheap admission bound."""
+        return int(np.asarray(self.edges).reshape(-1, 2).shape[0])
+
+    def canonical(self) -> Tuple[np.ndarray, np.ndarray,
+                                 Optional[np.ndarray]]:
+        """Memoized :func:`canonical_edges` of this spec — hashing (at
+        submit) and bucket packing (in the worker) share ONE O(E log E)
+        canonicalization pass."""
+        cached = getattr(self, "_canonical", None)
+        if cached is None:
+            cached = canonical_edges(self.edges, self.n_nodes,
+                                     self.weights)
+            object.__setattr__(self, "_canonical", cached)
+        return cached
+
+    def content_hash(self) -> str:
+        return hash_canonical(self.canonical(), self.n_nodes,
+                              self.config)
+
+
+class Job:
+    """One submission's mutable lifecycle record.
+
+    Field writes are guarded by the per-job lock; the service mutates
+    only through :meth:`mark` so HTTP handler threads always read a
+    consistent (state, result/error) pair via :meth:`describe`.
+    """
+
+    def __init__(self, spec: JobSpec, key: Optional[str] = None) -> None:
+        self.spec = spec
+        self.key = key if key is not None else spec.content_hash()
+        self.job_id = f"j{next(_job_seq):06d}-{self.key[:10]}"
+        self.state = STATE_QUEUED
+        self.submitted_at = time.time()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.error: Optional[str] = None
+        self.result: Optional[Dict[str, Any]] = None
+        self._lock = threading.Lock()
+
+    def mark(self, state: str, result: Optional[Dict[str, Any]] = None,
+             error: Optional[str] = None) -> None:
+        assert state in STATES, state
+        with self._lock:
+            self.state = state
+            if state == STATE_RUNNING:
+                self.started_at = time.time()
+            if state in (STATE_DONE, STATE_FAILED):
+                self.finished_at = time.time()
+            if result is not None:
+                self.result = result
+            if error is not None:
+                self.error = error
+
+    def describe(self) -> Dict[str, Any]:
+        """JSON-ready status summary (no result payload — that is
+        ``/result``'s job; keeps ``/status`` polls cheap)."""
+        with self._lock:
+            return {
+                "job_id": self.job_id,
+                "state": self.state,
+                "priority": self.spec.priority,
+                "content_hash": self.key,
+                "n_nodes": self.spec.n_nodes,
+                "algorithm": self.spec.config.algorithm,
+                "submitted_at": self.submitted_at,
+                "started_at": self.started_at,
+                "finished_at": self.finished_at,
+                "error": self.error,
+            }

@@ -1,0 +1,109 @@
+"""fcserve admission queue: bounded, thread-safe, priority-ordered.
+
+The serving layer's backpressure contract lives here: the queue has a
+**hard depth bound** and :meth:`AdmissionQueue.submit` on a full queue
+raises :class:`QueueFull` immediately — it never blocks the submitting
+HTTP thread and never grows without bound.  An overloaded server
+therefore answers "429, retry later" in microseconds instead of
+accepting work it cannot finish (the failure mode that turns overload
+into OOM or timeout storms; the north-star "heavy traffic" posture is
+*reject early, finish what you accepted*).
+
+Ordering is a min-heap on ``(priority, seq)``: lower priority values pop
+first (jobs.PRIORITY_INTERACTIVE before PRIORITY_BATCH) and equal
+priorities pop FIFO by admission order (``seq`` is assigned under the
+queue lock, so FIFO holds across concurrently submitting threads).
+
+Drain: :meth:`close` stops admissions (submit raises
+:class:`QueueClosed` -> HTTP 503) while :meth:`pop` keeps handing out
+already-admitted jobs until the heap is empty, then returns ``None`` —
+the worker's signal that a graceful SIGTERM drain is complete
+(serve/server.py).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import List, Optional, Tuple
+
+from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.serve.jobs import Job
+
+
+class QueueFull(RuntimeError):
+    """Admission refused: the queue is at its depth bound (backpressure,
+    not an internal error — HTTP maps it to 429 with Retry-After)."""
+
+    def __init__(self, depth: int, max_depth: int) -> None:
+        super().__init__(
+            f"queue full ({depth}/{max_depth} jobs); retry later")
+        self.depth = depth
+        self.max_depth = max_depth
+
+
+class QueueClosed(RuntimeError):
+    """Admission refused: the service is draining (HTTP 503)."""
+
+
+class AdmissionQueue:
+    """Bounded thread-safe priority queue of :class:`Job`s."""
+
+    def __init__(self, max_depth: int) -> None:
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = int(max_depth)
+        self._heap: List[Tuple[int, int, Job]] = []
+        self._seq = 0
+        self._closed = False
+        self._cond = threading.Condition()
+        self._reg = obs_counters.get_registry()
+
+    def submit(self, job: Job) -> None:
+        """Admit ``job`` or raise :class:`QueueFull` /
+        :class:`QueueClosed` — never blocks, never exceeds the bound."""
+        with self._cond:
+            if self._closed:
+                self._reg.inc("serve.queue.rejected_draining")
+                raise QueueClosed("service is draining; not accepting jobs")
+            if len(self._heap) >= self.max_depth:
+                self._reg.inc("serve.queue.rejected_full")
+                raise QueueFull(len(self._heap), self.max_depth)
+            self._seq += 1
+            heapq.heappush(self._heap, (job.spec.priority, self._seq, job))
+            self._reg.inc("serve.queue.admitted")
+            self._reg.gauge("serve.queue.depth", len(self._heap))
+            self._cond.notify()
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Job]:
+        """Next job by (priority, admission order).
+
+        Blocks until a job is available or the queue is closed *and*
+        empty (returns ``None`` — drain complete).  With ``timeout``,
+        also returns ``None`` if nothing arrived in time; callers that
+        need to distinguish check :meth:`draining`.
+        """
+        with self._cond:
+            while True:
+                if self._heap:
+                    _, _, job = heapq.heappop(self._heap)
+                    self._reg.gauge("serve.queue.depth", len(self._heap))
+                    return job
+                if self._closed:
+                    return None
+                if not self._cond.wait(timeout):
+                    return None
+
+    def close(self) -> None:
+        """Stop admissions; wake blocked poppers so they can drain."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._heap)
+
+    def draining(self) -> bool:
+        with self._cond:
+            return self._closed

@@ -1,0 +1,571 @@
+"""fcserve: a long-lived consensus service over ``run_consensus``.
+
+Every pre-existing entry point (cli.py, bench.py) is one-shot: each
+invocation pays process start, graph load and executable warm-up, then
+throws the compiled state away.  The serving layer keeps ONE resident
+process whose jitted executables are reused across requests:
+
+* requests are padded onto canonical shape buckets (serve/bucketer.py),
+  so distinct graphs share executables and warm-bucket requests compile
+  **zero** times (counted live by ``analysis.CompileGuard`` into the
+  fcobs registry — ``/metricsz`` shows it);
+* identical work is answered from a content-addressed LRU+TTL result
+  cache (serve/cache.py) without touching the device at all;
+* admission control is explicit: a bounded priority queue
+  (serve/queue.py) rejects overload with backpressure (HTTP 429),
+  oversized graphs are refused up front (413), and a draining server
+  says so (503) — accepted work always finishes.
+
+Threading model: HTTP handler threads (stdlib ``ThreadingHTTPServer``)
+only touch the queue / cache / jobs table; a SINGLE worker thread drives
+the device.  That is deliberate, not a simplification — one accelerator
+serializes executions anyway, and a single dispatch thread keeps jit
+caches, fcobs counters and the CompileGuard accounting race-free.
+Throughput comes from amortizing compiles and skipping cached work, not
+from concurrent device entry.
+
+Shutdown: SIGTERM (serve/__main__.py) closes the queue, finishes every
+admitted job, optionally exports the server's own fcobs trace artifacts
+(``--trace-dir``), and exits 0 — a graceful drain, never dropped work.
+
+The whole front end is stdlib-only (http.server / json / urllib on the
+client side): no new dependencies ride in with the subsystem.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from fastconsensus_tpu.cli import ALGORITHMS, DEFAULT_TAU
+from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs.tracer import get_tracer
+from fastconsensus_tpu.serve import bucketer
+from fastconsensus_tpu.serve.jobs import (PRIORITY_BATCH,
+                                          PRIORITY_INTERACTIVE,
+                                          PRIORITY_NAMES, PRIORITY_NORMAL,
+                                          STATE_DONE, STATE_FAILED,
+                                          STATE_QUEUED, STATE_RUNNING, Job,
+                                          JobSpec)
+from fastconsensus_tpu.serve.queue import (AdmissionQueue, QueueClosed,
+                                           QueueFull)
+from fastconsensus_tpu.serve.cache import ResultCache
+
+_logger = logging.getLogger("fastconsensus_tpu")
+
+# Finished-job retention (status/result remain queryable this long after
+# completion); bounded so the jobs table cannot grow without limit.
+MAX_RETAINED_JOBS = 4096
+# Resident-memory bound on the server's own tracer (--trace-dir): spans
+# stream to the .jsonl continuously; once this many have streamed, the
+# in-memory list resets (the drain-time Perfetto blob then covers the
+# recent window — the full history lives in the .jsonl).
+TRACE_EVENT_WINDOW = 20_000
+
+
+class GraphTooLarge(ValueError):
+    """Admission refused before queueing (HTTP 413)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Operator knobs for one service instance."""
+
+    queue_depth: int = 64
+    cache_entries: int = 256
+    cache_ttl_s: float = 3600.0
+    max_nodes: int = 1 << 20
+    max_edges: int = 1 << 22
+    drain_timeout_s: float = 300.0
+    # Pin the engine's adaptive executable sizing while serving (applied
+    # as env defaults in start(); an operator-set env var wins): member
+    # splitting off, fused block fixed.  Rate-adaptive sizing is right
+    # for one long run; for a resident server cycling heterogeneous
+    # requests it re-sizes (recompiles) shared bucket executables on
+    # measurement drift — exactly the cost serving exists to amortize.
+    pin_sizing: bool = True
+    # Where drain() writes the server's own fcobs artifacts
+    # (fcserve_trace.json + .jsonl); None = no server-side tracing.
+    trace_dir: Optional[str] = None
+    # Most-recent-samples window applied to the process-global fcobs
+    # series registry at start() (ObsRegistry.set_series_limit): a
+    # resident server observes per-job/per-round latencies forever, and
+    # unbounded sample lists are a slow leak.  0/None disables.
+    series_window: Optional[int] = 4096
+
+
+class ConsensusService:
+    """The queue -> bucket -> cache -> ``run_consensus`` pipeline."""
+
+    def __init__(self, config: Optional[ServeConfig] = None) -> None:
+        self.config = config or ServeConfig()
+        self.queue = AdmissionQueue(self.config.queue_depth)
+        self.cache = ResultCache(max_entries=self.config.cache_entries,
+                                 ttl_seconds=self.config.cache_ttl_s)
+        self._jobs: "OrderedDict[str, Job]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._worker: Optional[threading.Thread] = None
+        self._tracer = None
+        self._trace_jsonl: Optional[str] = None
+        self._streamed_events = 0
+        self._buckets: Dict[str, int] = {}
+        self._started_at = time.time()
+        self._reg = obs_counters.get_registry()
+
+    # -- lifecycle ---------------------------------------------------
+
+    def start(self) -> "ConsensusService":
+        """Launch the worker thread (idempotent)."""
+        if self._worker is not None:
+            return self
+        if self.config.pin_sizing:
+            os.environ.setdefault("FCTPU_DETECT_CALL_MEMBERS", "0")
+            os.environ.setdefault("FCTPU_ROUNDS_BLOCK", "8")
+        if self.config.series_window:
+            self._reg.set_series_limit(self.config.series_window)
+        if self.config.trace_dir:
+            from fastconsensus_tpu.obs import Tracer, set_tracer
+
+            os.makedirs(self.config.trace_dir, exist_ok=True)
+            self._trace_jsonl = os.path.join(self.config.trace_dir,
+                                             "fcserve_trace.json.jsonl")
+            open(self._trace_jsonl, "w", encoding="utf-8").close()
+            self._tracer = Tracer()
+            set_tracer(self._tracer)
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        name="fcserve-worker", daemon=True)
+        self._worker.start()
+        return self
+
+    def begin_drain(self) -> None:
+        """Stop admissions; already-admitted jobs keep running."""
+        self.queue.close()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: close intake, finish every admitted job,
+        export the server trace (``trace_dir``).  True = fully drained."""
+        self.begin_drain()
+        ok = True
+        if self._worker is not None:
+            self._worker.join(timeout if timeout is not None
+                              else self.config.drain_timeout_s)
+            ok = not self._worker.is_alive()
+        if ok:
+            self._export_trace()
+        else:
+            # the worker is STILL RUNNING a job: exporting now would
+            # race its per-job _flush_trace on the stream index and the
+            # .jsonl file (duplicate/desynced records); the streamed
+            # .jsonl up to the last finished job is already on disk
+            _logger.warning(
+                "fcserve drain timed out with a job in flight; "
+                "skipping trace export (streamed .jsonl is intact)")
+        return ok
+
+    def _flush_trace(self) -> None:
+        """Stream newly finished spans to the .jsonl (once per job) and
+        bound resident span memory: past TRACE_EVENT_WINDOW streamed
+        spans the in-memory list resets — the history is already on
+        disk, and a heavy-traffic server must not retain every span of
+        every request until drain.  Only the worker thread opens spans,
+        so the between-jobs clear races nothing."""
+        if self._tracer is None or self._trace_jsonl is None:
+            return
+        new = self._tracer.events_since(self._streamed_events)
+        if new:
+            self._streamed_events += len(new)
+            with open(self._trace_jsonl, "a", encoding="utf-8") as fh:
+                for ev in new:
+                    fh.write(json.dumps({"kind": "span", **ev}) + "\n")
+        if self._streamed_events > TRACE_EVENT_WINDOW:
+            self._tracer.clear()
+            self._streamed_events = 0
+
+    def _export_trace(self) -> None:
+        if self._tracer is None or not self.config.trace_dir:
+            return
+        from fastconsensus_tpu.obs import export as obs_export
+        from fastconsensus_tpu.obs import set_tracer
+
+        set_tracer(None)
+        self._flush_trace()
+        snapshot = self._reg.snapshot()
+        # Perfetto blob from the retained (recent-window) spans; the
+        # complete stream is the .jsonl next to it
+        events = self._tracer.events()
+        path = os.path.join(self.config.trace_dir, "fcserve_trace.json")
+        obs_export.write_perfetto(path, events, snapshot,
+                                  process_name="fcserve")
+        with open(self._trace_jsonl, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps({"kind": "counters", **snapshot}) + "\n")
+        _logger.info("fcserve trace written to %s (+.jsonl)", path)
+
+    # -- submission --------------------------------------------------
+
+    def submit(self, spec: JobSpec) -> Job:
+        """Admit a job (or answer it from the cache immediately).
+
+        Raises :class:`GraphTooLarge` (413), :class:`queue.QueueFull`
+        (429) or :class:`queue.QueueClosed` (503); on success the
+        returned job is either queued, or already DONE when its content
+        hash hit the cache — a cache hit costs no queue slot, so cached
+        traffic flows even through a saturated queue.
+        """
+        n_raw = spec.n_edges_raw()
+        if n_raw < 1:
+            raise ValueError("graph has no edges")
+        if spec.n_nodes > self.config.max_nodes:
+            raise GraphTooLarge(
+                f"graph has {spec.n_nodes} nodes; this server admits at "
+                f"most {self.config.max_nodes}")
+        if n_raw > self.config.max_edges:
+            raise GraphTooLarge(
+                f"graph has {n_raw} edges; this server admits at most "
+                f"{self.config.max_edges}")
+        job = Job(self._normalize_spec(spec))
+        cached = self.cache.get(job.key)
+        if cached is not None:
+            job.mark(STATE_DONE, result=dict(cached, cached=True))
+            self._remember(job)
+            self._reg.inc("serve.jobs.cached")
+            return job
+        self.queue.submit(job)   # QueueFull/QueueClosed propagate
+        self._remember(job)
+        return job
+
+    def job(self, job_id: str) -> Optional[Job]:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def _normalize_spec(self, spec: JobSpec) -> JobSpec:
+        """Drop an ignored gamma before hashing.
+
+        Detectors without a gamma parameter compute identical results
+        at every gamma, so letting it into the content hash would
+        fragment the cache with distinct keys for provably identical
+        work — the same fingerprint poisoning cli.py normalizes away
+        for checkpoints/detect caches.
+        """
+        if spec.config.gamma != 1.0:
+            from fastconsensus_tpu.models.registry import supports_param
+
+            if not supports_param(spec.config.algorithm, "gamma"):
+                spec = dataclasses.replace(
+                    spec, config=dataclasses.replace(spec.config,
+                                                     gamma=1.0))
+        return spec
+
+    def _remember(self, job: Job) -> None:
+        with self._lock:
+            self._jobs[job.job_id] = job
+            while len(self._jobs) > MAX_RETAINED_JOBS:
+                # evict the oldest FINISHED job only: an admitted
+                # (queued/running) job must stay queryable for its whole
+                # lifetime even while cache-hit traffic churns the table
+                for jid, j in self._jobs.items():
+                    if j.state in (STATE_DONE, STATE_FAILED):
+                        del self._jobs[jid]
+                        break
+                else:
+                    break  # everything retained is live work
+
+    # -- the worker --------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            job = self.queue.pop()
+            if job is None:
+                return  # queue closed and drained
+            job.mark(STATE_RUNNING)
+            try:
+                result = self.run_spec(job.spec, key=job.key)
+                job.mark(STATE_DONE, result=result)
+                self._reg.inc("serve.jobs.completed")
+            except Exception as e:  # noqa: BLE001 — one bad job must
+                # never take down the worker (and with it every queued
+                # job behind it); the failure is the job's result
+                job.mark(STATE_FAILED, error=f"{type(e).__name__}: {e}")
+                self._reg.inc("serve.jobs.failed")
+                _logger.warning("fcserve job %s failed: %s", job.job_id,
+                                job.error)
+            self._flush_trace()
+
+    def run_spec(self, spec: JobSpec,
+                 key: Optional[str] = None) -> Dict[str, Any]:
+        """Run one spec to a result payload (cache-aware, synchronous).
+
+        This is the worker's core, callable directly (tests, embedded
+        use).  Compiles during the run are counted live into the fcobs
+        registry (``serve.xla_compiles``); a request landing in a warm
+        bucket counts zero — the serving contract.
+        """
+        from fastconsensus_tpu.analysis import CompileGuard
+        from fastconsensus_tpu.consensus import run_consensus
+        from fastconsensus_tpu.models.registry import get_detector
+
+        spec = self._normalize_spec(spec)
+        key = key if key is not None else spec.content_hash()
+        # re-check, not first-check: the worker path already counted
+        # this admission's miss in submit(); recounting it here would
+        # halve the /metricsz hit rate (a hit IS a genuine serve — an
+        # identical queued job finished first — and always counts)
+        cached = self.cache.get(key, count_miss=False)
+        if cached is not None:
+            return dict(cached, cached=True)
+        slab, bucket = bucketer.pad_to_bucket(
+            spec.edges, spec.n_nodes, spec.weights,
+            max_nodes=self.config.max_nodes,
+            max_edges=self.config.max_edges,
+            canonical=spec.canonical())
+        # get_detector is memoized, so every job of one (alg, gamma)
+        # shares the detector object jit keys its executables on
+        detect = get_detector(spec.config.algorithm,
+                              gamma=spec.config.gamma)
+        tracer = get_tracer()
+        t0 = time.perf_counter()
+        guard = CompileGuard(registry=self._reg,
+                             counter="serve.xla_compiles")
+        with tracer.span("serve.job", bucket=bucket.key(),
+                         alg=spec.config.algorithm):
+            with guard:
+                res = run_consensus(slab, detect, spec.config,
+                                    n_closure=bucket.n_closure)
+        elapsed = time.perf_counter() - t0
+        partitions = []
+        for p in res.partitions:
+            # fcheck: ok=sync-in-loop (partitions are already host numpy
+            # — run_consensus does its one bulk readback; this loop only
+            # slices off the bucket's padding nodes and recompacts ids)
+            lab = np.asarray(p)[: spec.n_nodes]
+            _, compact = np.unique(lab, return_inverse=True)
+            partitions.append(compact.astype(np.int32))
+        result = {
+            "content_hash": key,
+            "bucket": bucket.describe(),
+            "partitions": partitions,
+            "n_nodes": spec.n_nodes,
+            "rounds": res.rounds,
+            "converged": res.converged,
+            "compiles": guard.count,
+            "elapsed_s": round(elapsed, 6),
+            "cached": False,
+        }
+        self.cache.put(key, result)
+        with self._lock:
+            self._buckets[bucket.key()] = \
+                self._buckets.get(bucket.key(), 0) + 1
+        self._reg.observe("serve.job.seconds", elapsed)
+        return result
+
+    # -- introspection -----------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            states: Dict[str, int] = {}
+            for j in self._jobs.values():
+                states[j.state] = states.get(j.state, 0) + 1
+            buckets = dict(self._buckets)
+        return {
+            "uptime_s": round(time.time() - self._started_at, 3),
+            "draining": self.queue.draining(),
+            "queue_depth": self.queue.depth(),
+            "queue_max_depth": self.queue.max_depth,
+            "cache_entries": len(self.cache),
+            "jobs": states,
+            "buckets": buckets,
+        }
+
+
+# ---------------------------------------------------------------------
+# HTTP front end (stdlib http.server)
+# ---------------------------------------------------------------------
+
+def _parse_spec(payload: Dict[str, Any],
+                max_body_edges: int) -> JobSpec:
+    """A JobSpec from a ``/submit`` JSON body (raises ValueError)."""
+    from fastconsensus_tpu.consensus import ConsensusConfig
+
+    if "edgelist" in payload:
+        rows = []
+        for lineno, ln in enumerate(
+                str(payload["edgelist"]).splitlines(), start=1):
+            ln = ln.split("#", 1)[0].strip()
+            if not ln:
+                continue
+            parts = ln.split()
+            if len(parts) < 2:
+                raise ValueError(
+                    f"edgelist line {lineno}: expected 'u v', got {ln!r}")
+            rows.append((int(parts[0]), int(parts[1])))
+        edges = np.asarray(rows, dtype=np.int64).reshape(-1, 2)
+    else:
+        edges = np.asarray(payload.get("edges", ()),
+                           dtype=np.int64).reshape(-1, 2)
+    if edges.shape[0] < 1:
+        raise ValueError("no edges in request body")
+    if edges.shape[0] > max_body_edges:
+        raise GraphTooLarge(
+            f"request carries {edges.shape[0]} edges; this server admits "
+            f"at most {max_body_edges}")
+    n_nodes = int(payload.get("n_nodes", int(edges.max()) + 1))
+    if edges.min() < 0 or edges.max() >= n_nodes:
+        raise ValueError(
+            f"edge endpoints must be compact ids in [0, {n_nodes})")
+    alg = str(payload.get("algorithm", "louvain"))
+    if alg not in ALGORITHMS:
+        raise ValueError(
+            f"unknown algorithm {alg!r}; available: {', '.join(ALGORITHMS)}")
+    cfg_kwargs: Dict[str, Any] = {"algorithm": alg}
+    for field, cast in (("n_p", int), ("tau", float), ("delta", float),
+                        ("max_rounds", int), ("seed", int),
+                        ("gamma", float), ("auto_grow", bool),
+                        ("warm_start", bool), ("align_frac", float),
+                        ("closure_sampler", str),
+                        ("closure_tau", lambda v: None if v is None
+                         else float(v))):
+        if field in payload:
+            cfg_kwargs[field] = cast(payload[field])
+    cfg_kwargs.setdefault("tau", DEFAULT_TAU[alg])
+    config = ConsensusConfig(**cfg_kwargs)
+    if config.closure_sampler not in ("auto", "csr", "scatter"):
+        raise ValueError(
+            f"closure_sampler={config.closure_sampler!r}: expected "
+            f"'auto', 'csr' or 'scatter'")
+    if not 0.0 <= config.tau <= 1.0:
+        raise ValueError(f"tau {config.tau} out of range 0..1")
+    if not 0.0 <= config.delta <= 1.0:
+        raise ValueError(f"delta {config.delta} out of range 0..1")
+    if config.n_p < 1 or config.max_rounds < 1:
+        raise ValueError("n_p and max_rounds must be >= 1")
+    prio = payload.get("priority", PRIORITY_NORMAL)
+    if isinstance(prio, str):
+        if prio not in PRIORITY_NAMES:
+            raise ValueError(
+                f"unknown priority {prio!r}; one of "
+                f"{', '.join(PRIORITY_NAMES)} or an int")
+        priority = PRIORITY_NAMES[prio]
+    else:
+        priority = int(prio)
+        if not PRIORITY_INTERACTIVE <= priority <= PRIORITY_BATCH:
+            # unclamped ints would let any client jump ahead of every
+            # documented class — the priority scheme is an enforced
+            # contract, not a suggestion
+            raise ValueError(
+                f"priority {priority} out of range "
+                f"{PRIORITY_INTERACTIVE}..{PRIORITY_BATCH}")
+    return JobSpec(edges=edges, n_nodes=n_nodes, config=config,
+                   priority=priority)
+
+
+def _result_json(result: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(result)
+    out["partitions"] = [np.asarray(p).tolist()
+                         for p in result["partitions"]]
+    return out
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """Routes: POST /submit; GET /status/<id> /result/<id> /healthz
+    /metricsz."""
+
+    server_version = "fcserve/1"
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def service(self) -> ConsensusService:
+        return self.server.fcserve_service  # type: ignore[attr-defined]
+
+    def log_message(self, fmt: str, *args) -> None:  # noqa: A003
+        _logger.debug("fcserve http: " + fmt, *args)
+
+    def _send(self, code: int, payload: Dict[str, Any],
+              headers: Optional[Dict[str, str]] = None) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path.rstrip("/") != "/submit":
+            self._send(404, {"error": f"no such endpoint {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+            spec = _parse_spec(payload, self.service.config.max_edges)
+        except GraphTooLarge as e:
+            self._send(413, {"error": str(e)})
+            return
+        except (ValueError, TypeError, KeyError) as e:
+            self._send(400, {"error": f"bad request: {e}"})
+            return
+        try:
+            job = self.service.submit(spec)
+        except GraphTooLarge as e:
+            self._send(413, {"error": str(e)})
+            return
+        except QueueFull as e:
+            # THE backpressure response: explicit, immediate, retryable
+            self._send(429, {"error": str(e), "backpressure": True,
+                             "queue_depth": e.depth,
+                             "queue_max_depth": e.max_depth},
+                       headers={"Retry-After": "1"})
+            return
+        except QueueClosed as e:
+            self._send(503, {"error": str(e), "draining": True})
+            return
+        except ValueError as e:
+            self._send(400, {"error": str(e)})
+            return
+        self._send(202 if job.state == STATE_QUEUED else 200,
+                   {"job_id": job.job_id, "state": job.state,
+                    "content_hash": job.key,
+                    "cached": job.state == STATE_DONE})
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        path = self.path.rstrip("/")
+        if path == "/healthz":
+            stats = self.service.stats()
+            self._send(200, {"ok": True, **stats})
+            return
+        if path == "/metricsz":
+            self._send(200, {"fcobs": self.service._reg.snapshot(),
+                             "serve": self.service.stats()})
+            return
+        for prefix in ("/status/", "/result/"):
+            if path.startswith(prefix):
+                job = self.service.job(path[len(prefix):])
+                if job is None:
+                    self._send(404, {"error": "unknown job id"})
+                    return
+                if prefix == "/status/":
+                    self._send(200, job.describe())
+                elif job.state == STATE_DONE:
+                    self._send(200, _result_json(job.result))
+                elif job.state == STATE_FAILED:
+                    self._send(500, job.describe())
+                else:
+                    self._send(202, job.describe())
+                return
+        self._send(404, {"error": f"no such endpoint {self.path}"})
+
+
+def make_http_server(service: ConsensusService, host: str = "127.0.0.1",
+                     port: int = 8765) -> ThreadingHTTPServer:
+    """Bind the HTTP front end (``port=0`` picks a free port)."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.fcserve_service = service  # type: ignore[attr-defined]
+    return httpd

@@ -125,6 +125,28 @@ def members_per_call(slab: GraphSlab, n_p: int,
     return g
 
 
+def grid_up(n: int, minimum: int = 1) -> int:
+    """Smallest value >= n on the coarse {2^k, 3*2^k} grid (1, 2, 3, 4,
+    6, 8, 12, 16, 24, 32, 48, 64, ...).
+
+    The same grid :func:`members_per_call` snaps DOWN onto, exposed for
+    callers that must snap UP: the serving layer's shape buckets
+    (serve/bucketer.py) pad every incoming graph's (n_nodes, n_edges) to
+    a grid class so distinct graphs share compiled executables — the
+    serving analog of the member-count quantization above (an
+    un-quantized shape per request would be a fresh multi-minute compile
+    per request).  Successive classes are at most 4/3 apart, so padding
+    waste is bounded at ~33% while the number of distinct classes (and
+    thus resident executables) stays logarithmic in graph size.
+    """
+    n = max(int(n), int(minimum), 1)
+    p = 1
+    while p < n:
+        p *= 2
+    q = (3 * p) // 4
+    return q if p >= 4 and q >= n else p
+
+
 def read_sizing(cache_dir: str) -> Optional[dict]:
     """The detect-call sizing a previous process used with this chunk-cache
     dir (run_consensus.setup_executables: a restart must reuse the killed

@@ -85,6 +85,101 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== fcserve: serving smoke (cache hit, backpressure, drain) =="
+SERVE_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR" "$SERVE_DIR"; [ -n "$SERVE_PID" ] && kill -9 "$SERVE_PID" 2>/dev/null' EXIT
+SERVE_PORT=$(python - <<'PYEOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+PYEOF
+)
+# queue depth 1: the overload burst below must overflow deterministically
+JAX_PLATFORMS=cpu python -m fastconsensus_tpu.serve --host 127.0.0.1 \
+    --port "$SERVE_PORT" --queue-depth 1 --trace-dir "$SERVE_DIR" --quiet &
+SERVE_PID=$!
+JAX_PLATFORMS=cpu python - "$SERVE_PORT" <<'PYEOF'
+import json
+import sys
+import time
+
+from fastconsensus_tpu.serve.client import Backpressure, ServeClient
+from fastconsensus_tpu.utils.io import read_edgelist
+
+client = ServeClient(f"http://127.0.0.1:{int(sys.argv[1])}", timeout=30.0)
+for _ in range(150):          # wait out server startup (jax import)
+    try:
+        client.healthz()
+        break
+    except Exception:
+        time.sleep(0.2)
+else:
+    sys.exit("fcserve never came up")
+edges, _, ids = read_edgelist("examples/karate_club.txt")
+spec = dict(edges=edges.tolist(), n_nodes=len(ids), algorithm="lpm",
+            n_p=4, delta=0.1, max_rounds=2, seed=1)
+a = client.submit(**spec)
+ra = client.wait(a["job_id"], timeout=300)
+assert not ra.get("cached"), ra
+b = client.submit(**spec)     # identical resubmission: answered from cache
+rb = client.wait(b["job_id"], timeout=60)
+assert rb.get("cached"), rb
+m = client.metricsz()
+assert m["fcobs"]["counters"].get("serve.cache.hit", 0) >= 1, m
+# Overload burst: distinct jobs at a NEW shape (n_p=8), so the first
+# one compiles for seconds while the rest arrive in milliseconds — the
+# depth-1 queue must reject with explicit backpressure, never absorb.
+accepted, rejected = [], 0
+for seed in range(2, 12):
+    try:
+        accepted.append(client.submit(**dict(spec, n_p=8, max_rounds=4,
+                                             seed=seed)))
+    except Backpressure:
+        rejected += 1
+assert rejected >= 1, "overload burst produced no 429 backpressure"
+assert accepted, "overload burst was rejected entirely"
+for sub in accepted:          # admitted work must still finish
+    client.wait(sub["job_id"], timeout=300)
+h = client.healthz()
+assert h.get("ok") and not h.get("draining"), h
+json.dumps(client.metricsz())  # /metricsz stays JSON end to end
+print(f"fcserve smoke ok: cache hit served, {rejected} burst "
+      f"rejection(s), {len(accepted)} burst job(s) completed")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcserve smoke failed (exit $rc)" >&2
+    exit $rc
+fi
+kill -TERM "$SERVE_PID"
+wait "$SERVE_PID"
+rc=$?
+SERVE_PID=""
+if [ $rc -ne 0 ]; then
+    echo "fcserve did not drain cleanly on SIGTERM (exit $rc)" >&2
+    exit $rc
+fi
+python - "$SERVE_DIR" <<'PYEOF'
+import json
+import os
+import sys
+
+path = os.path.join(sys.argv[1], "fcserve_trace.json")
+blob = json.load(open(path))
+assert blob["traceEvents"], "server trace recorded no events"
+counters = blob["otherData"]["counters"]["counters"]
+assert counters.get("serve.jobs.completed", 0) >= 1, counters
+print(f"fcserve drain ok: trace artifact parses, "
+      f"{counters.get('serve.jobs.completed')} job(s) completed")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcserve drain-time trace artifact failed to parse (exit $rc)" >&2
+    exit $rc
+fi
+
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"
     exit 0

@@ -1,0 +1,23 @@
+"""Violating fixture: sharding axis names no mesh in this module
+declares (mesh-axis).  The mesh contract here is ("p", "e")."""
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ENSEMBLE_AXIS = "p"
+
+
+def make_mesh(devices):
+    return Mesh(np.asarray(devices).reshape(-1, 1), (ENSEMBLE_AXIS, "e"))
+
+
+def bad_collective(x):
+    # "q" is a typo: the mesh has axes p/e only — this fails at runtime
+    # on a real mesh, which is exactly what the lint preempts
+    return jax.lax.psum(x, "q")
+
+
+def bad_spec(mesh, x):
+    # "edge" is not the declared axis name "e"
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P("edge")))

@@ -1,0 +1,26 @@
+"""Clean fixture: every sharding axis name resolves to a declared mesh
+axis (mesh-axis)."""
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ENSEMBLE_AXIS = "p"
+EDGE_AXIS = "e"
+
+
+def make_mesh(devices):
+    return Mesh(np.asarray(devices).reshape(-1, 1),
+                (ENSEMBLE_AXIS, EDGE_AXIS))
+
+
+def good_collective(x):
+    return jax.lax.psum(x, EDGE_AXIS)
+
+
+def good_spec(mesh, x):
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(ENSEMBLE_AXIS, None)))
+
+
+def good_literal(x):
+    return jax.lax.pmax(x, "p")

@@ -29,6 +29,7 @@ RULE_FIXTURES = [
     ("bad_retrace.py", "ok_retrace_cached.py", "retrace-risk", 1),
     ("bad_kernel_closure.py", "ok_kernel_module.py",
      "kernel-tracer-closure", 1),
+    ("bad_mesh_axis.py", "ok_mesh_axis.py", "mesh-axis", 2),
 ]
 
 
@@ -50,6 +51,35 @@ def test_weak_static_arg_and_module_const_ride_along():
     assert any(d.rule == "module-jnp-const" for d in diags)
     diags, _ = _lint("ok_kernel_module.py")
     assert not diags, [d.format() for d in diags]
+
+
+def test_mesh_axis_silent_without_a_declared_mesh():
+    """A module declaring no axis constants and no Mesh has no contract
+    to check — bare psum("x") there must not fire (shard_map callees
+    see axes their CALLER's mesh declares)."""
+    from fastconsensus_tpu.analysis.astlint import lint_source
+
+    diags, _ = lint_source(
+        "import jax\n\n\ndef f(x):\n    return jax.lax.psum(x, 'x')\n",
+        filename="<anon>")
+    assert not [d for d in diags if d.rule == "mesh-axis"], \
+        [d.format() for d in diags]
+
+
+def test_mesh_axis_clean_on_the_real_sharding_modules():
+    """The rule's raison d'être: parallel/sharding.py and
+    ops/sharded_tail.py declare the ("p", "e") contract and must lint
+    clean against it (a typo'd axis in either fails here before it
+    fails at runtime on a real mesh)."""
+    from fastconsensus_tpu.analysis import Report, lint_paths
+
+    pkg = os.path.join(os.path.dirname(__file__), "..",
+                       "fastconsensus_tpu")
+    report = lint_paths([os.path.join(pkg, "parallel", "sharding.py"),
+                         os.path.join(pkg, "ops", "sharded_tail.py")],
+                        Report())
+    assert not [d for d in report.diagnostics if d.rule == "mesh-axis"], \
+        report.format_human()
 
 
 def test_pragma_suppresses_and_is_counted():

@@ -411,6 +411,65 @@ def test_jsonl_chain_reader_rebases_ts_across_segments(tmp_path,
     assert counters[-1]["counters"]["rounds.total"] == 3
 
 
+def test_jsonl_chain_reader_picks_up_profiler_sidecars(tmp_path,
+                                                       registry):
+    """ROADMAP follow-up: a supervised --trace --profile-dir run leaves
+    rotated Perfetto blobs (with merged profiler events) NEXT TO the
+    rotated JSONL segments — supervise --rotate moves both in lockstep.
+    ``read_jsonl_chain(with_profiler=True)`` splices each attempt's
+    profiler events back in, attempt-tagged and ts-rebased; metadata
+    rows and fcobs spans (already in the JSONL) are not duplicated."""
+    import json as _json
+
+    from fastconsensus_tpu.obs import export as obs_export
+
+    jsonl = str(tmp_path / "trace.json.jsonl")
+    perfetto = str(tmp_path / "trace.json")
+
+    def perfetto_blob(dev_ts):
+        return {"traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 7, "tid": 0,
+             "ts": 0, "args": {"name": "/device:TPU:0"}},
+            {"name": "fusion.1", "ph": "X", "cat": "tpu", "ts": dev_ts,
+             "dur": 10, "pid": 7, "tid": 1},
+            {"name": "round", "ph": "X", "cat": "fcobs", "ts": 100,
+             "dur": 50, "pid": 1, "tid": 1},
+        ]}
+
+    # attempt 1 (rotated pair .1): spans end at ts 150
+    obs_export.write_jsonl(jsonl + ".1", _sample_events(),
+                           registry.snapshot())
+    with open(perfetto + ".1", "w") as fh:
+        _json.dump(perfetto_blob(dev_ts=120), fh)
+    # live attempt 2
+    obs_export.write_jsonl(jsonl, _sample_events(), registry.snapshot())
+    with open(perfetto, "w") as fh:
+        _json.dump(perfetto_blob(dev_ts=30), fh)
+
+    records = obs_export.read_jsonl_chain(jsonl, with_profiler=True)
+    prof = [r for r in records if r["kind"] == "profiler"]
+    assert [p["attempt"] for p in prof] == [1, 2]
+    assert all(p["name"] == "fusion.1" for p in prof), prof
+    # attempt 1's device event keeps its own clock; attempt 2's rebases
+    # by attempt 1's span end — same offset the spans got
+    seg1_end = max(r["ts"] + r.get("dur", 0) for r in records
+                   if r["kind"] == "span" and r["attempt"] == 1)
+    assert prof[0]["ts"] == 120
+    assert prof[1]["ts"] == 30 + seg1_end
+    # no metadata rows, no duplicated fcobs spans
+    assert all(p.get("ph") != "M" and p.get("cat") != "fcobs"
+               for p in prof)
+    # default stays profiler-free (backwards compatible)
+    assert all(r["kind"] != "profiler"
+               for r in obs_export.read_jsonl_chain(jsonl))
+    # a corrupt sidecar contributes nothing rather than failing the read
+    with open(perfetto, "w") as fh:
+        fh.write("{not json")
+    records = obs_export.read_jsonl_chain(jsonl, with_profiler=True)
+    assert [r["attempt"] for r in records if r["kind"] == "profiler"] \
+        == [1]
+
+
 def test_jsonl_streamer_survives_abrupt_death(tmp_path, registry):
     """The CLI's .jsonl sidecar streams per flush: a SIGKILLed process
     (no close(), no finally) still leaves every flushed span on disk,

@@ -1,0 +1,472 @@
+"""fcserve: admission queue, shape buckets, result cache, and the
+serving contract — same-bucket requests reuse executables (0 warm
+compiles), identical resubmissions answer from the cache (no detect
+spans), overload rejects with explicit backpressure."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+
+def _ring_graph(n, chords=0, shift=7):
+    """Deterministic ring (+ optional chord family): n nodes,
+    n + chords edges."""
+    idx = np.arange(n)
+    edges = [np.stack([idx, (idx + 1) % n], 1)]
+    if chords:
+        c = np.arange(chords)
+        edges.append(np.stack([c % n, (c + shift) % n], 1))
+    return np.concatenate(edges).astype(np.int64)
+
+
+def _spec(edges, n_nodes, priority=None, **over):
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.jobs import PRIORITY_NORMAL, JobSpec
+
+    kwargs = dict(algorithm="louvain", n_p=4, tau=0.2, delta=0.02,
+                  max_rounds=2, seed=0)
+    kwargs.update(over)
+    return JobSpec(edges=np.asarray(edges, dtype=np.int64),
+                   n_nodes=n_nodes, config=ConsensusConfig(**kwargs),
+                   priority=PRIORITY_NORMAL if priority is None
+                   else priority)
+
+
+@pytest.fixture
+def service():
+    from fastconsensus_tpu.serve.server import ConsensusService, ServeConfig
+
+    # pin_sizing=False: the env pins are the resident server's posture;
+    # tests must not leak FCTPU_* into the rest of the suite
+    return ConsensusService(ServeConfig(queue_depth=4, pin_sizing=False))
+
+
+# -- sizing ladder / buckets ------------------------------------------
+
+
+def test_grid_up_ladder_boundaries():
+    from fastconsensus_tpu.sizing import grid_up
+
+    assert [grid_up(v) for v in (1, 2, 3, 4, 5, 6, 7)] == \
+        [1, 2, 3, 4, 6, 6, 8]
+    # exactly at a class stays; one past jumps to the next rung
+    assert grid_up(48) == 48 and grid_up(49) == 64
+    assert grid_up(64) == 64 and grid_up(65) == 96
+    assert grid_up(96) == 96 and grid_up(97) == 128
+    assert grid_up(10, minimum=64) == 64
+
+
+def test_bucket_for_boundaries_and_limits():
+    from fastconsensus_tpu.serve.bucketer import (MIN_EDGE_CLASS,
+                                                  MIN_NODE_CLASS, Bucket,
+                                                  BucketTooLarge,
+                                                  bucket_for)
+
+    assert bucket_for(5, 4) == Bucket(MIN_NODE_CLASS, MIN_EDGE_CLASS)
+    assert bucket_for(96, 96) == Bucket(96, 96)       # exactly at class
+    assert bucket_for(97, 96).n_class == 128          # one over: next rung
+    assert bucket_for(96, 97).e_class == 128
+    with pytest.raises(BucketTooLarge):
+        bucket_for(1000, 10, max_nodes=512)
+    with pytest.raises(BucketTooLarge):
+        bucket_for(10, 1000, max_edges=512)
+    with pytest.raises(ValueError):
+        bucket_for(0, 0)
+
+
+def test_pad_to_bucket_canonicalizes_statics(karate_edges):
+    """Two distinct graphs in one bucket must produce slabs with
+    IDENTICAL static metadata — that identity IS the executable-sharing
+    contract (jit cache keys include every static field)."""
+    from fastconsensus_tpu.serve.bucketer import pad_to_bucket
+
+    edges, _, ids = karate_edges           # 34 nodes, 78 edges
+    g2 = _ring_graph(40, chords=40)        # 40 nodes, 80 edges
+    s1, b1 = pad_to_bucket(edges, len(ids))
+    s2, b2 = pad_to_bucket(g2, 40)
+    assert b1 == b2
+    statics = lambda s: (s.n_nodes, s.capacity, s.d_cap, s.cap_hint,  # noqa: E731
+                         s.d_hyb, s.hub_cap, s.agg_cap)
+    assert statics(s1) == statics(s2)
+    assert s1.d_cap == 0 and s1.d_hyb == 0 and s1.hub_cap == 0
+    # content still belongs to each graph
+    assert int(np.asarray(s1.alive).sum()) == 78
+    assert int(np.asarray(s2.alive).sum()) == 80
+
+
+def test_content_hash_is_order_invariant(karate_edges):
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.jobs import content_hash
+
+    edges, _, ids = karate_edges
+    cfg = ConsensusConfig()
+    h1 = content_hash(edges, len(ids), cfg)
+    rng = np.random.default_rng(0)
+    shuffled = edges[rng.permutation(edges.shape[0])]
+    flipped = np.stack([shuffled[:, 1], shuffled[:, 0]], 1)
+    assert content_hash(flipped, len(ids), cfg) == h1
+    # any result-relevant config field changes the address
+    assert content_hash(edges, len(ids),
+                        ConsensusConfig(seed=1)) != h1
+
+
+# -- admission queue ---------------------------------------------------
+
+
+def test_queue_rejects_when_full_and_when_closed(karate_edges):
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.jobs import Job
+    from fastconsensus_tpu.serve.queue import (AdmissionQueue, QueueClosed,
+                                               QueueFull)
+
+    edges, _, ids = karate_edges
+    base = obs_counters.get_registry().counters()
+    q = AdmissionQueue(max_depth=2)
+    q.submit(Job(_spec(edges, len(ids), seed=1)))
+    q.submit(Job(_spec(edges, len(ids), seed=2)))
+    with pytest.raises(QueueFull) as e:
+        q.submit(Job(_spec(edges, len(ids), seed=3)))
+    assert e.value.depth == 2 and e.value.max_depth == 2
+    assert q.depth() == 2   # the bound held — nothing was absorbed
+    q.close()
+    with pytest.raises(QueueClosed):
+        q.submit(Job(_spec(edges, len(ids), seed=4)))
+    since = obs_counters.get_registry().counters_since(base)
+    assert since.get("serve.queue.rejected_full", 0) >= 1
+    assert since.get("serve.queue.rejected_draining", 0) >= 1
+    # drain: admitted jobs still pop, then None
+    assert q.pop() is not None and q.pop() is not None
+    assert q.pop() is None
+
+
+def test_queue_priority_order_under_contention(karate_edges):
+    """Concurrent submitters; pops must come out priority-major,
+    admission-order (seq) minor — the heap contract under contention."""
+    from fastconsensus_tpu.serve.jobs import (PRIORITY_BATCH,
+                                              PRIORITY_INTERACTIVE,
+                                              PRIORITY_NORMAL, Job)
+    from fastconsensus_tpu.serve.queue import AdmissionQueue
+
+    edges, _, ids = karate_edges
+    q = AdmissionQueue(max_depth=64)
+    prios = (PRIORITY_BATCH, PRIORITY_INTERACTIVE, PRIORITY_NORMAL)
+    start = threading.Barrier(4)
+
+    def submitter(tid):
+        start.wait()
+        for i in range(8):
+            q.submit(Job(_spec(edges, len(ids), seed=tid * 100 + i,
+                               priority=prios[(tid + i) % 3])))
+
+    threads = [threading.Thread(target=submitter, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    popped = []
+    while True:
+        job = q.pop(timeout=0.01)
+        if job is None:
+            break
+        popped.append(job)
+    assert len(popped) == 32
+    prios_out = [j.spec.priority for j in popped]
+    assert prios_out == sorted(prios_out)
+
+
+# -- result cache ------------------------------------------------------
+
+
+def test_cache_lru_eviction_and_recency():
+    from fastconsensus_tpu.serve.cache import ResultCache
+
+    c = ResultCache(max_entries=2, ttl_seconds=60.0)
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1          # refresh a's recency
+    c.put("c", 3)                   # evicts b (LRU), not a
+    assert c.get("b") is None
+    assert c.get("a") == 1 and c.get("c") == 3
+    assert len(c) == 2
+
+
+def test_cache_ttl_expiry_deterministic():
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.cache import ResultCache
+
+    now = [0.0]
+    c = ResultCache(max_entries=4, ttl_seconds=10.0, clock=lambda: now[0])
+    base = obs_counters.get_registry().counters()
+    c.put("k", "v")
+    now[0] = 9.9
+    assert c.get("k") == "v"
+    now[0] = 10.1
+    assert c.get("k") is None       # expired, dropped on touch
+    assert len(c) == 0
+    since = obs_counters.get_registry().counters_since(base)
+    assert since.get("serve.cache.expired", 0) == 1
+    assert since.get("serve.cache.hit", 0) == 1
+    assert since.get("serve.cache.miss", 0) == 1
+
+
+def test_thin_client_imports_are_jax_free():
+    """The cli.py --server contract: a client process imports
+    serve.client + utils.io (and the packages above them) without
+    importing jax — thin clients must not require (or pay for) the
+    engine.  jax is POISONED in sys.modules (None makes any
+    `import jax` raise), so a regression that re-eagers the package
+    inits fails loudly even though sitecustomize preloads jax."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "from fastconsensus_tpu.serve.client import ServeClient\n"
+        "from fastconsensus_tpu.utils.io import read_edgelist\n"
+        "import fastconsensus_tpu.serve\n"
+        "print('jax-free ok')\n")
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, PYTHONPATH=os.path.abspath(root))
+    res = subprocess.run([sys.executable, "-c", code], cwd=root, env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "jax-free ok" in res.stdout
+
+
+def test_jobspec_canonical_is_memoized(karate_edges):
+    edges, _, ids = karate_edges
+    spec = _spec(edges, len(ids))
+    u1, v1, _ = spec.canonical()
+    u2, v2, _ = spec.canonical()
+    assert u1 is u2 and v1 is v2  # one O(E log E) pass per spec
+    # and pad_to_bucket accepts it without re-canonicalizing
+    from fastconsensus_tpu.serve.bucketer import pad_to_bucket
+
+    slab, _ = pad_to_bucket(spec.edges, spec.n_nodes,
+                            canonical=spec.canonical())
+    assert int(np.asarray(slab.alive).sum()) == 78
+
+
+def test_registry_series_window_bounds_memory():
+    """A resident server must not grow RSS with every observed latency
+    sample: set_series_limit keeps the most recent window only (and the
+    summary describes that window)."""
+    from fastconsensus_tpu.obs.counters import ObsRegistry
+
+    reg = ObsRegistry()
+    for i in range(10):
+        reg.observe("s", float(i))
+    reg.set_series_limit(4)
+    assert reg.series("s") == [6.0, 7.0, 8.0, 9.0]  # retroactive trim
+    reg.observe("s", 10.0)
+    assert reg.series("s") == [7.0, 8.0, 9.0, 10.0]
+    reg.set_series_limit(None)
+    for i in range(6):
+        reg.observe("s", float(i))
+    assert len(reg.series("s")) == 10  # unbounded again
+
+
+# -- the serving contract ---------------------------------------------
+
+
+def test_same_bucket_zero_warm_compiles(service, karate_edges):
+    """ISSUE 4 acceptance: with the server warm, a DISTINCT graph that
+    maps into the same size bucket compiles nothing — bucket-canonical
+    shapes + memoized detectors make the first request's executables
+    serve the whole bucket."""
+    from fastconsensus_tpu.analysis import assert_max_compiles
+
+    edges, _, ids = karate_edges
+    g2 = _ring_graph(40, chords=40)
+    r1 = service.run_spec(_spec(edges, len(ids)))
+    assert not r1["cached"] and r1["rounds"] >= 1
+    with assert_max_compiles(0):
+        r2 = service.run_spec(_spec(g2, 40))
+    assert r2["bucket"] == r1["bucket"]
+    assert not r2["cached"]
+    assert len(r2["partitions"]) == 4
+    assert r2["partitions"][0].shape == (40,)   # padding sliced off
+    assert r1["partitions"][0].shape == (34,)
+
+
+def test_cache_hit_increments_counter_and_records_no_detect_spans(
+        service, karate_edges):
+    from fastconsensus_tpu.obs import Tracer, use_tracer
+    from fastconsensus_tpu.obs import counters as obs_counters
+
+    edges, _, ids = karate_edges
+    service.run_spec(_spec(edges, len(ids), seed=7))
+    base = obs_counters.get_registry().counters()
+    with use_tracer(Tracer()) as tr:
+        r2 = service.run_spec(_spec(edges, len(ids), seed=7))
+    assert r2["cached"]
+    since = obs_counters.get_registry().counters_since(base)
+    assert since.get("serve.cache.hit", 0) == 1
+    names = {e["name"] for e in tr.events()}
+    assert not any(n.startswith(("detect", "round", "serve.job",
+                                 "setup_executables"))
+                   for n in names), names
+
+
+def test_worker_and_submit_path(service, karate_edges):
+    """submit -> queue -> worker -> done; identical resubmission is DONE
+    at submit time (cache hit bypasses the queue entirely); one computed
+    admission counts exactly ONE cache miss (the worker's pre-run
+    re-probe must not double it — /metricsz hit-rate accuracy)."""
+    import time
+
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.jobs import STATE_DONE
+
+    edges, _, ids = karate_edges
+    base = obs_counters.get_registry().counters()
+    service.start()
+    try:
+        job = service.submit(_spec(edges, len(ids), seed=11))
+        deadline = time.monotonic() + 120
+        while job.state not in ("done", "failed"):
+            assert time.monotonic() < deadline, job.describe()
+            time.sleep(0.02)
+        assert job.state == STATE_DONE, job.error
+        assert job.result["partitions"][0].shape == (len(ids),)
+        again = service.submit(_spec(edges, len(ids), seed=11))
+        assert again.state == STATE_DONE and again.result["cached"]
+        since = obs_counters.get_registry().counters_since(base)
+        assert since.get("serve.cache.miss", 0) == 1, since
+        assert since.get("serve.cache.hit", 0) == 1, since
+    finally:
+        assert service.drain(30)
+
+
+def test_ignored_gamma_does_not_fragment_the_cache(service, karate_edges):
+    """lpm has no gamma parameter: gamma=1.5 and gamma=1.0 compute
+    identical partitions, so they must share one content address
+    (the fingerprint normalization cli.py applies locally)."""
+    edges, _, ids = karate_edges
+    j_gamma = service.submit(_spec(edges, len(ids), algorithm="lpm",
+                                   delta=0.1, seed=5, gamma=1.5))
+    j_plain = service.submit(_spec(edges, len(ids), algorithm="lpm",
+                                   delta=0.1, seed=5, gamma=1.0))
+    assert j_gamma.key == j_plain.key
+    # louvain DOES take gamma: distinct addresses stay distinct
+    k1 = service.submit(_spec(edges, len(ids), seed=6, gamma=1.5)).key
+    k2 = service.submit(_spec(edges, len(ids), seed=6, gamma=1.0)).key
+    assert k1 != k2
+
+
+def test_submit_rejects_oversized_graphs(karate_edges):
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                GraphTooLarge, ServeConfig)
+
+    edges, _, ids = karate_edges
+    svc = ConsensusService(ServeConfig(max_nodes=16, pin_sizing=False))
+    with pytest.raises(GraphTooLarge):
+        svc.submit(_spec(edges, len(ids)))
+
+
+def test_failed_job_does_not_kill_worker(service):
+    """A bad spec fails ITS job; the worker survives to run the next."""
+    import time
+
+    service.start()
+    try:
+        # closure_tau out of range raises inside run_consensus — a
+        # config error the HTTP layer can't pre-screen fails the job,
+        # not the worker
+        bad = _spec(np.array([[0, 1]]), 2, closure_tau=5.0)
+        good = _spec(_ring_graph(12, chords=6), 12, seed=3)
+        jb = service.submit(bad)
+        jg = service.submit(good)
+        deadline = time.monotonic() + 120
+        while jg.state not in ("done", "failed"):
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        assert jb.state == "failed" and "closure_tau" in jb.error
+        assert jg.state == "done", jg.error
+    finally:
+        assert service.drain(30)
+
+
+def test_pin_sizing_env_defaults(monkeypatch):
+    from fastconsensus_tpu.serve.server import ConsensusService, ServeConfig
+
+    monkeypatch.delenv("FCTPU_DETECT_CALL_MEMBERS", raising=False)
+    monkeypatch.delenv("FCTPU_ROUNDS_BLOCK", raising=False)
+    svc = ConsensusService(ServeConfig(pin_sizing=True))
+    svc.start()
+    try:
+        assert os.environ["FCTPU_DETECT_CALL_MEMBERS"] == "0"
+        assert os.environ["FCTPU_ROUNDS_BLOCK"] == "8"
+    finally:
+        assert svc.drain(10)
+        monkeypatch.delenv("FCTPU_DETECT_CALL_MEMBERS", raising=False)
+        monkeypatch.delenv("FCTPU_ROUNDS_BLOCK", raising=False)
+
+
+# -- HTTP front end ----------------------------------------------------
+
+
+def test_http_endpoints_roundtrip(karate_edges):
+    """submit / 429 backpressure / status / result / healthz / metricsz
+    / 503-on-drain over a real loopback socket.  The worker is started
+    only AFTER the queue is full, so the 429 is deterministic."""
+    import json
+
+    from fastconsensus_tpu.serve.client import (Backpressure, ServeClient,
+                                                ServeError)
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig,
+                                                make_http_server)
+
+    edges, _, ids = karate_edges
+    svc = ConsensusService(ServeConfig(queue_depth=1, pin_sizing=False))
+    httpd = make_http_server(svc, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout=30.0)
+    try:
+        payload = dict(edges=edges.tolist(), n_nodes=len(ids),
+                       algorithm="lpm", n_p=4, delta=0.1, max_rounds=2,
+                       seed=1)
+        sub = client.submit(**payload)
+        assert sub["state"] == "queued"
+        with pytest.raises(Backpressure) as e:
+            client.submit(**dict(payload, seed=2))
+        assert e.value.payload["backpressure"] is True
+        # unknown routes / ids and malformed bodies answer, not crash
+        with pytest.raises(ServeError):
+            client.status("nope")
+        with pytest.raises(ServeError):
+            client._request("/submit", {"edges": []})
+        with pytest.raises(ServeError) as e:    # one-token edgelist line
+            client._request("/submit", {"edgelist": "0 1\n5\n"})
+        assert e.value.status == 400 and "line 2" in str(e.value)
+        with pytest.raises(ServeError) as e:    # priority out of range
+            client.submit(**dict(payload, seed=9, priority=-1_000_000))
+        assert e.value.status == 400 and "priority" in str(e.value)
+        svc.start()
+        res = client.wait(sub["job_id"], timeout=120)
+        assert res["n_nodes"] == len(ids)
+        assert len(res["partitions"]) == 4
+        assert client.status(sub["job_id"])["state"] == "done"
+        again = client.submit(**payload)
+        assert again["cached"] is True
+        h = client.healthz()
+        assert h["ok"] and not h["draining"]
+        m = client.metricsz()
+        json.dumps(m)  # fully JSON-serializable
+        assert m["fcobs"]["counters"].get("serve.cache.hit", 0) >= 1
+        assert m["serve"]["buckets"]
+        svc.begin_drain()
+        with pytest.raises(ServeError) as e:
+            client.submit(**dict(payload, seed=3))
+        assert e.value.status == 503
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        assert svc.drain(30)
