@@ -14,8 +14,8 @@ the consensus round is one jitted function built from segment reductions.
 from fastconsensus_tpu.version import __version__
 
 __all__ = ["GraphSlab", "pack_edges", "host_edges", "fast_consensus",
-           "run_consensus", "ConsensusConfig", "get_detector",
-           "__version__"]
+           "run_consensus", "run_consensus_batch", "ConsensusConfig",
+           "get_detector", "__version__"]
 
 
 def __getattr__(name):
@@ -29,7 +29,8 @@ def __getattr__(name):
         from fastconsensus_tpu import graph
 
         return getattr(graph, name)
-    if name in ("fast_consensus", "run_consensus", "ConsensusConfig"):
+    if name in ("fast_consensus", "run_consensus", "run_consensus_batch",
+                "ConsensusConfig"):
         from fastconsensus_tpu import consensus
 
         return getattr(consensus, name)

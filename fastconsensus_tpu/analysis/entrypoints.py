@@ -62,7 +62,8 @@ def entry_points() -> List[EntryPoint]:
     import jax
     import jax.numpy as jnp
 
-    from fastconsensus_tpu.engine import consensus_round, consensus_tail
+    from fastconsensus_tpu.engine import (consensus_batch_block,
+                                          consensus_round, consensus_tail)
     from fastconsensus_tpu.models.registry import available, get_detector
     from fastconsensus_tpu.ops import consensus_ops as cops
     from fastconsensus_tpu.ops import dense_adj as da
@@ -171,6 +172,35 @@ def entry_points() -> List[EntryPoint]:
             mk(lambda s, k, d=det: consensus_round(
                 s, k, detect=d, n_p=N_P, tau=0.2, delta=0.02,
                 n_closure=32), slab, jax.random.fold_in(key, 100 + i))))
+    # The cross-request batch path (serve coalescing): the vmapped batch
+    # block at the canonical B=2, warm mode — the shape every serving
+    # rung lowers through, audited once here so the f64/device_put/
+    # huge-gather rules cover the batched lowering too.
+    import functools
+
+    from fastconsensus_tpu import policy
+    from fastconsensus_tpu.graph import stack_slabs
+
+    det_b = get_detector("louvain")
+    det_warm = getattr(det_b, "warm_variant", None) or det_b
+    slab2 = stack_slabs([slab, slab])
+    keys2 = jax.random.wrap_key_data(jnp.stack(
+        [jax.random.key_data(jax.random.fold_in(key, 200 + j))
+         for j in range(2)]))
+    labels2 = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32), (2, N_P, n))
+    pst2 = policy.PolicyState(*(jnp.zeros((2,), jnp.int32)
+                                for _ in policy.PolicyState._fields))
+    batch_fn = jax.vmap(functools.partial(
+        consensus_batch_block, detect=det_warm, n_p=N_P, tau=0.2,
+        delta=0.02, n_closure=32, block=2, mode="warm", align_frac=1.0,
+        sampler="csr"))
+    eps.append(EntryPoint(
+        "engine.consensus_batch_block[B=2]",
+        mk(batch_fn, slab2, keys2, labels2,
+           jnp.ones((2,), jnp.int32), jnp.full((2,), 2, jnp.int32),
+           jnp.zeros((2,), bool), pst2, jnp.zeros((2,), bool),
+           jnp.full((2, 3), -1, jnp.int32))))
     # native cnm/infomap go through pure_callback (host C++) — they are
     # deliberately NOT device programs, so they are not audited here;
     # available() still decides whether their registry entries resolve.

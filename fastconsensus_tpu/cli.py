@@ -201,6 +201,15 @@ def _run_remote(args) -> int:
             f"max_rounds={args.max_rounds} reached"
         src = "cache" if result.get("cached") else \
             f"bucket {result.get('bucket', {}).get('key')}"
+        if result.get("batch_size", 1) > 1 and not result.get("cached"):
+            # the server coalesced this run with same-bucket requests
+            # into one batched device call (fcserve cross-request
+            # batching); surface it so the shared elapsed_s reads
+            # right.  Cache hits skip it: their payload carries the
+            # ORIGINAL computation's batch metadata as provenance, not
+            # a batch that ran for this request.
+            src += (f", coalesced x{result['batch_size']} as "
+                    f"{result.get('batch_id')}")
         print(f"{state} after {result.get('rounds')} round(s) in "
               f"{elapsed:.2f}s (served from {src})", file=sys.stderr)
     suffix = f"t{args.tau}_d{args.delta}_np{args.n_p}"

@@ -132,8 +132,9 @@ class ConsensusConfig:
 # public API surface this module historically carried (tests, graft entry,
 # sharded_tail all import them from fastconsensus_tpu.consensus).
 from fastconsensus_tpu.engine import (  # noqa: E402,F401
-    RoundStats, _detect_chunked, _jitted_detect, _jitted_round,
-    _jitted_rounds_block, _jitted_tail, consensus_round,
+    RoundStats, _detect_chunked, _jitted_detect, _jitted_detect_batch,
+    _jitted_round, _jitted_rounds_batch, _jitted_rounds_block,
+    _jitted_tail, consensus_batch_block, consensus_round,
     consensus_rounds_block, consensus_tail)
 
 
@@ -249,6 +250,28 @@ def _resume_from_checkpoint(checkpoint_path: str, slab: GraphSlab,
             measured_member_s, resumed_converged, sampler, saved_counters)
 
 
+def _validate_config(config: ConsensusConfig) -> None:
+    """Shared range/enum validation for the solo and batch drivers —
+    ONE implementation so the two paths can never drift into accepting
+    different configs (the batch path's parity contract presumes the
+    same config means the same behavior)."""
+    if config.closure_sampler not in ("auto", "csr", "scatter"):
+        raise ValueError(
+            f"closure_sampler={config.closure_sampler!r}: expected "
+            f"'auto', 'csr' or 'scatter'")
+    if config.closure_tau is not None and \
+            not 0.0 <= config.closure_tau <= 1.0:
+        raise ValueError(
+            f"closure_tau={config.closure_tau} out of range; allowed "
+            f"values are 0..1 (or None to disable)")
+    if not 0.0 <= config.align_frac <= 1.0:
+        # out-of-range values would silently disable (or saturate)
+        # alignment (ADVICE r3)
+        raise ValueError(
+            f"align_frac={config.align_frac} out of range; allowed "
+            f"values are 0..1")
+
+
 class ConsensusResult(NamedTuple):
     partitions: List[np.ndarray]   # n_p final label vectors, compact ids
     graph: GraphSlab               # converged consensus graph
@@ -308,21 +331,7 @@ def run_consensus(slab: GraphSlab,
     if n_closure is None:
         n_closure = int(slab.num_alive())  # L := |E0|, static across rounds
     n_closure = int(n_closure)
-    if config.closure_sampler not in ("auto", "csr", "scatter"):
-        raise ValueError(
-            f"closure_sampler={config.closure_sampler!r}: expected "
-            f"'auto', 'csr' or 'scatter'")
-    if config.closure_tau is not None and \
-            not 0.0 <= config.closure_tau <= 1.0:
-        raise ValueError(
-            f"closure_tau={config.closure_tau} out of range; allowed "
-            f"values are 0..1 (or None to disable)")
-    if not 0.0 <= config.align_frac <= 1.0:
-        # out-of-range values would silently disable (or saturate)
-        # alignment (ADVICE r3)
-        raise ValueError(
-            f"align_frac={config.align_frac} out of range; allowed "
-            f"values are 0..1")
+    _validate_config(config)
     # Resolved wedge-sampling lowering (ConsensusConfig.closure_sampler):
     # an edge-sharded mesh requires the sort-free engine; single-chip runs
     # default to the CSR fast path.
@@ -1058,6 +1067,283 @@ def run_consensus(slab: GraphSlab,
     partitions = [all_labels[i] for i in range(config.n_p)]
     return ConsensusResult(partitions=partitions, graph=slab, rounds=rounds,
                            converged=converged, history=history)
+
+
+def run_consensus_batch(slabs,
+                        detect: Detector,
+                        config: ConsensusConfig,
+                        n_closure: int,
+                        seeds=None,
+                        keys=None) -> List[ConsensusResult]:
+    """Run B independent same-bucket consensus jobs as ONE device-call
+    stream: the batch analog of :func:`run_consensus`.
+
+    The paper's core structure — n_p independent detector runs vmapped
+    into one ensemble — extends one axis up: B independent *graphs*
+    stacked along a leading batch axis drive a batch-vmapped variant of
+    the fused round block (engine.consensus_batch_block), so a burst of
+    small same-bucket requests costs ~one graph's dispatch/readback
+    latency instead of B of them (the fcserve coalescing path).
+
+    **Bit-parity contract**: every job's partitions are identical to
+    running it alone through :func:`run_consensus` at the same seed.
+    The PRNG tree keys per job (``seeds[b]`` / ``keys[b]`` is job b's
+    run key — exactly ``jax.random.key(seed)``), per-round keys derive
+    in-batch exactly as the solo driver derives them, and the policy
+    rules fold per-element with the same functions.  Warm-stagnation
+    cold refreshes stay batched (a masked singleton-init round through
+    the cold-mode block — the solo driver's round_mode() fires from the
+    identical policy state).  Whenever a job's trajectory would deviate
+    in a way that changes STATIC shapes — slab auto-growth
+    (``n_dropped > 0``) or a budget re-derivation
+    (``policy.budgets_stale``) — that job is **split off to a solo
+    tail**: its batched progress is discarded and it re-runs start to
+    finish through ``run_consensus`` with its own key, which IS the
+    parity definition.  Converged jobs mask to no-ops (their while-loop
+    carry freezes) until the whole batch finishes.
+
+    Restrictions vs the solo driver (all serving-path irrelevancies):
+    no mesh, no checkpoint/resume, no detect-chunk cache, whole-ensemble
+    detection only (the fcserve posture, ``FCTPU_DETECT_CALL_MEMBERS=0``).
+    ``n_closure`` is REQUIRED: it is a static shape shared by the whole
+    batch, so the caller must pass the bucket-canonical L
+    (serve/bucketer.Bucket.n_closure) rather than letting each graph
+    default to its own alive count.
+
+    ``seeds`` gives job b the run key ``jax.random.key(seeds[b])``
+    (default: ``config.seed`` for every job — only useful with distinct
+    graphs); ``keys`` passes pre-built run keys instead.  Returns one
+    :class:`ConsensusResult` per input slab, in order.
+    """
+    from fastconsensus_tpu.graph import stack_slabs
+
+    B = len(slabs)
+    if B < 1:
+        raise ValueError("run_consensus_batch needs at least one slab")
+    if keys is not None and seeds is not None:
+        raise ValueError("pass seeds or keys, not both")
+    if keys is None:
+        seeds = list(seeds) if seeds is not None else [config.seed] * B
+        if len(seeds) != B:
+            raise ValueError(f"{len(seeds)} seeds for {B} slabs")
+        keys = [jax.random.key(int(s)) for s in seeds]
+    keys = list(keys)
+    if len(keys) != B:
+        raise ValueError(f"{len(keys)} keys for {B} slabs")
+    _validate_config(config)
+    # same resolution as the solo driver (batching is single-chip only)
+    sampler = "csr" if config.closure_sampler == "auto" \
+        else config.closure_sampler
+    n_closure = int(n_closure)
+    tracer = get_tracer()
+    obs_reg = obs_counters.get_registry()
+
+    warm = config.warm_start and getattr(detect, "supports_init", False)
+    align_ok = getattr(detect, "supports_align", False)
+    detect_warm = (getattr(detect, "warm_variant", None) or detect) \
+        if warm else detect
+    detect_refresh = getattr(detect, "refresh_variant", None) or detect
+    align_frac = config.align_frac if (warm and align_ok) else 0.0
+    fb_env = env_int("FCTPU_ROUNDS_BLOCK")
+    block = max(1, min(8, fb_env)) if fb_env else 8
+
+    base = slabs[0]
+    n_nodes, n_p = base.n_nodes, config.n_p
+    # weights <- 1.0 at loop start, per slab (run_consensus parity)
+    slabs = [s.with_weights(jnp.where(s.alive, 1.0, 0.0)) for s in slabs]
+    stacked = stack_slabs(slabs)
+    keys_b = jax.random.wrap_key_data(jnp.stack(
+        [jax.random.key_data(k) for k in keys]))
+
+    sing = jnp.broadcast_to(jnp.arange(n_nodes, dtype=jnp.int32),
+                            (B, n_p, n_nodes))
+    labels = sing if warm else jnp.zeros((B, n_p, n_nodes), jnp.int32)
+
+    histories: List[List[dict]] = [[] for _ in range(B)]
+    pstates = [policy.state_from_history([]) for _ in range(B)]
+    conv = np.zeros(B, bool)
+    rounds = np.zeros(B, np.int64)
+    solo = np.zeros(B, bool)       # split off to the solo tail
+    watch = np.full(B, bool(config.auto_grow))
+    noop = np.full((B, 3), -1, np.int32)
+
+    def align_next(i: int) -> bool:
+        """The solo driver's align_now(r) for job i's next round (every
+        batched round has r >= 1 and a non-empty history)."""
+        if not (warm and align_ok and config.align_frac > 0
+                and histories[i]):
+            return False
+        return bool(policy.align_now(np, config.align_frac, pstates[i]))
+
+    def refresh_due(i: int) -> bool:
+        """Would the solo driver's round_mode() run job i's next round
+        cold (stagnation refresh)?  Split it off if so."""
+        if not warm or not histories[i]:
+            return False
+        return bool(policy.stale(np, config.delta, pstates[i])) or \
+            bool(policy.stalled(np, config.delta, pstates[i],
+                                align_next(i)))
+
+    def budgets_fire(entry: dict) -> bool:
+        """Would the solo driver's maybe_regrow_budgets() act on this
+        round's stats?  (First firing only — the batch splits off before
+        any no-op suppression state can accrue.)"""
+        if not config.auto_grow:
+            return False
+        return bool(policy.budgets_stale(
+            np, entry["n_overflow"], entry["n_hub_overflow"], base.d_cap,
+            base.hub_cap, base.n_nodes, entry["n_alive"], base.agg_cap))
+
+    def split_off(i: int, why: str) -> None:
+        solo[i] = True
+        obs_reg.inc("batch.solo_splits")
+        _logger.info("batch job %d split off to solo tail (%s)", i, why)
+
+    def record_block(done, buf) -> None:
+        """Fold one batched block's readback into the per-job state —
+        the batch form of the solo driver's record()."""
+        for i in range(B):
+            if solo[i] or conv[i]:
+                continue
+            for j in range(int(done[i])):
+                st = jax.tree.map(lambda b: b[i][j], buf)
+                if config.auto_grow and int(st.n_dropped) > 0:
+                    # the solo driver would grow-and-replay this round
+                    split_off(i, f"slab saturated at round {rounds[i]}")
+                    break
+                entry = {
+                    "round": int(rounds[i]) + 1,
+                    "n_alive": int(st.n_alive),
+                    "n_unconverged": int(st.n_unconverged),
+                    "n_closure_added": int(st.n_closure_added),
+                    "n_repaired": int(st.n_repaired),
+                    "n_dropped": int(st.n_dropped),
+                    "n_overflow": int(st.n_overflow),
+                    "n_hub_overflow": int(st.n_hub_overflow),
+                    "cold": bool(st.cold),
+                    "capacity": base.capacity,
+                }
+                histories[i].append(entry)
+                pstates[i] = policy.observe(
+                    np, pstates[i], np.bool_(entry["cold"]),
+                    np.int32(entry["n_unconverged"]),
+                    np.int32(entry["n_alive"]))
+                rounds[i] += 1
+                conv[i] = bool(st.converged)
+                if budgets_fire(entry):
+                    # the solo driver would re-derive budgets (a static-
+                    # shape change) at the next loop top / before the
+                    # final detection
+                    split_off(i, f"budget re-derivation at round "
+                                 f"{rounds[i]}")
+                    break
+                if conv[i]:
+                    break
+
+    def pst_b():
+        return policy.PolicyState(*(jnp.asarray(
+            np.stack([np.int32(getattr(pstates[i], f))
+                      for i in range(B)]))
+            for f in policy.PolicyState._fields))
+
+    def active():
+        return ~conv & ~solo & (rounds < config.max_rounds)
+
+    def run_block(mode: str, det, block_n: int, only=None) -> None:
+        nonlocal stacked, labels
+        mask = active() if only is None else (active() & only)
+        iters = np.where(mask, config.max_rounds - rounds, 0)
+        if block_n == 1:
+            iters = np.minimum(iters, 1)
+        fn = _jitted_rounds_batch(det, n_p, config.tau, config.delta,
+                                  n_closure, block_n, mode, align_frac,
+                                  sampler, config.closure_tau)
+        align0 = np.array([align_next(i) and mode == "warm"
+                           for i in range(B)])
+        with tracer.step_span("batch_block", int(rounds.min()),
+                              b=B, mode=mode):
+            # fcheck: ok=key-reuse (per-job run keys + traced round
+            # index; per-round keys derive in-block exactly as the solo
+            # driver derives them)
+            stacked, done, buf, new_labels = fn(
+                stacked, keys_b, labels,
+                jnp.asarray(rounds, jnp.int32),
+                jnp.asarray(iters, jnp.int32),
+                jnp.asarray(align0),
+                pst_b(),
+                jnp.asarray(watch),
+                jnp.asarray(noop))
+            # fcheck: ok=sync-in-loop (ONE bulk readback per batched
+            # block — B jobs' round counts + stats in a single transfer,
+            # the readback coalescing exists to amortize)
+            done, buf = jax.device_get((done, buf))
+        obs_counters.host_sync("batch_block_stats")
+        obs_reg.inc("batch.blocks")
+        labels = new_labels
+        record_block(done, buf)
+
+    if warm:
+        # absolute round 0: uniformly cold (singleton-init full sweeps)
+        run_block("cold", detect, 1)
+        while active().any():
+            # fcheck: ok=sync-in-loop (pure host-side policy numpy —
+            # refresh_due reads the recorded history, no device arrays)
+            refresh = np.array([bool(active()[i]) and refresh_due(i)
+                                for i in range(B)])
+            if refresh.any():
+                # Stagnation refreshes run BATCHED too: a refresh round
+                # is a singleton-init full-sweep round — the cold-mode
+                # body with the low-variance refresh variant — masked to
+                # exactly the elements whose policy fired (the others
+                # freeze at 0 iterations).  The solo driver's
+                # round_mode() takes the identical decision from the
+                # identical policy state, so parity holds.
+                obs_reg.inc("batch.refresh_rounds", int(refresh.sum()))
+                run_block("cold", detect_refresh, 1, only=refresh)
+                continue
+            run_block("warm", detect_warm, block)
+    else:
+        while active().any():
+            run_block("scratch", detect, block)
+
+    results: List[Optional[ConsensusResult]] = [None] * B
+    batched = [i for i in range(B) if not solo[i]]
+    if batched:
+        # batched final re-detection: per-job final keys derive exactly
+        # as the solo driver's (STREAM_FINAL off each job's run key)
+        final_keys = jax.vmap(
+            lambda k: prng.partition_keys(
+                prng.stream(k, prng.STREAM_FINAL), n_p))(keys_b)
+        final_detect = detect_warm if warm else detect
+        with tracer.span("batch_final_detect", b=B):
+            fd = _jitted_detect_batch(final_detect, warm)
+            out = fd(stacked, final_keys, labels) if warm \
+                else fd(stacked, final_keys)
+            # fcheck: ok=sync-in-loop (single bulk readback of the whole
+            # batch's [B, n_p, N] label block)
+            all_labels = jax.device_get(out)
+        obs_counters.host_sync("batch_final_labels")
+        for i in batched:
+            # counter folding happens HERE, not in record_block: a job
+            # split off to the solo tail discards its batched rounds,
+            # and run_consensus re-folds the rerun's rounds itself —
+            # folding eagerly would double-count every split job's
+            # prefix in rounds.total / closure totals
+            for entry in histories[i]:
+                obs_counters.fold_round(entry)
+            results[i] = ConsensusResult(
+                partitions=[all_labels[i][p] for p in range(n_p)],
+                graph=jax.tree.map(lambda x: x[i], stacked),
+                rounds=int(rounds[i]), converged=bool(conv[i]),
+                history=histories[i])
+    for i in range(B):
+        if solo[i]:
+            # the solo tail: discard the batched progress and re-run
+            # this job alone with its own key — solo execution is the
+            # parity reference, so the answer is identical by definition
+            results[i] = run_consensus(slabs[i], detect, config,
+                                       key=keys[i], n_closure=n_closure)
+    return results  # type: ignore[return-value]
 
 
 def fast_consensus(edges: np.ndarray,

@@ -439,6 +439,155 @@ def _jitted_rounds_block(detect: Detector, detect_warm: Detector,
         align_frac=align_frac, sampler=sampler, closure_tau=closure_tau))
 
 
+def consensus_batch_block(slab: GraphSlab,
+                          key: jax.Array,
+                          labels0: jax.Array,
+                          start_round: jax.Array,
+                          max_iters: jax.Array,
+                          align0: jax.Array,
+                          pstate0: policy.PolicyState,
+                          watch0: jax.Array,
+                          noop0: jax.Array,
+                          detect: Detector,
+                          n_p: int,
+                          tau: float,
+                          delta: float,
+                          n_closure: int,
+                          block: int,
+                          mode: str,
+                          align_frac: float = 0.0,
+                          sampler: str = "scatter",
+                          closure_tau: Optional[float] = None
+                          ) -> Tuple[GraphSlab, jax.Array, RoundStats,
+                                     jax.Array]:
+    """One GRAPH's rounds for the cross-request batch path — vmapped over
+    a leading batch axis by :func:`_jitted_rounds_batch`.
+
+    :func:`consensus_rounds_block` decides cold/refresh/warm *in-loop*
+    with ``lax.cond``; under ``vmap`` a batched predicate lowers every
+    ``cond`` to ``select`` — BOTH detector branches execute for the whole
+    batch every round, which on compute-bound backends eats the entire
+    coalescing win (full-sweep cold detection costs a multiple of a
+    capped warm round).  This variant therefore carries ONE static
+    ``mode`` so the body traces exactly one detector path:
+
+    * ``"warm"``    — every round runs the capped-sweep ``detect`` from
+      the carried labels with the carried alignment flag; the loop STOPS
+      (element freezes) when the stagnation policy says the next round
+      must re-detect cold — the host driver splits that graph off to a
+      solo ``run_consensus`` tail instead of paying a batched cold
+      branch (consensus.run_consensus_batch).
+    * ``"cold"``    — every round is a singleton-init full-sweep round
+      (absolute round 0 of a warm run: uniform across the batch, so no
+      per-element branch is needed).
+    * ``"scratch"`` — every round cold-starts with no init (warm_start
+      off / detectors without ``supports_init``), the fused analog of
+      the unfused driver's ``warm=False`` path.
+
+    Per-round keys derive from ``(key, start_round + i)`` exactly as the
+    solo driver derives them, per-round policy folding is the same
+    ``policy.observe``, and each non-deviating element's computation is
+    the identical jaxpr per batch element — the bit-parity contract
+    tests/test_serve_batch.py pins.  The ``need`` (budget-starvation)
+    early stop mirrors :func:`consensus_rounds_block`; a stopped element
+    is likewise split off to a solo tail by the driver.  Stats rows past
+    each element's ``done`` count are garbage and must be ignored.
+    """
+    assert mode in ("warm", "cold", "scratch"), mode
+
+    def empty_stats():
+        z = jnp.zeros((block,), jnp.int32)
+        return RoundStats(converged=jnp.zeros((block,), bool), n_alive=z,
+                          n_unconverged=z, n_closure_added=z, n_repaired=z,
+                          n_dropped=z, n_overflow=z, n_hub_overflow=z,
+                          cold=jnp.zeros((block,), bool))
+
+    def cond(carry):
+        _, i, conv, _, _, aligned, pst, need = carry
+        go = (~conv) & (~need) & (i < block) & (i < max_iters)
+        if mode == "warm":
+            # stop BEFORE a round the solo driver would run cold
+            # (round_mode "refresh"): the host splits this graph off
+            refresh = policy.stale(jnp, delta, pst) | \
+                policy.stalled(jnp, delta, pst, aligned)
+            go = go & (~refresh)
+        return go
+
+    def body(carry):
+        slab, i, _, buf, labels, aligned, pst, _ = carry
+        k = prng.stream(key, prng.STREAM_ROUND, start_round + i)
+        if mode == "warm":
+            slab, labels, st = consensus_round(
+                slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
+                n_closure=n_closure, init_labels=labels, align=aligned,
+                sampler=sampler, closure_tau=closure_tau)
+            st = st._replace(cold=jnp.bool_(False))
+        else:
+            init = None
+            if mode == "cold":
+                init = jnp.broadcast_to(
+                    jnp.arange(labels.shape[1], dtype=jnp.int32),
+                    labels.shape)
+            slab, labels, st = consensus_round(
+                slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
+                n_closure=n_closure, init_labels=init, align=False,
+                sampler=sampler, closure_tau=closure_tau)
+            st = st._replace(cold=jnp.bool_(True))
+        pst = policy.observe(jnp, pst, st.cold, st.n_unconverged,
+                             st.n_alive)
+        buf = jax.tree.map(lambda b, s: b.at[i].set(s), buf, st)
+        if mode == "warm" and align_frac > 0:
+            aligned = policy.align_now(jnp, align_frac, pst)
+        else:
+            aligned = jnp.bool_(False)
+        need = policy.budgets_stale(jnp, st.n_overflow, st.n_hub_overflow,
+                                    slab.d_cap, slab.hub_cap,
+                                    slab.n_nodes, st.n_alive,
+                                    slab.agg_cap) & \
+            jnp.asarray(watch0) & \
+            ((st.n_overflow > noop0[0]) | (st.n_hub_overflow > noop0[1]) |
+             (st.n_alive > noop0[2]))
+        return (slab, i + 1, st.converged, buf, labels, aligned, pst, need)
+
+    pst0 = policy.PolicyState(*(jnp.asarray(v, jnp.int32)
+                                for v in pstate0))
+    slab, done, _, buf, labels, _, _, _ = jax.lax.while_loop(
+        cond, body,
+        (slab, jnp.int32(0), jnp.bool_(False), empty_stats(), labels0,
+         jnp.asarray(align0, bool), pst0, jnp.bool_(False)))
+    return slab, done, buf, labels
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_rounds_batch(detect: Detector, n_p: int, tau: float,
+                         delta: float, n_closure: int, block: int,
+                         mode: str, align_frac: float = 0.0,
+                         sampler: str = "scatter",
+                         closure_tau: Optional[float] = None):
+    """jit(vmap) of :func:`consensus_batch_block`: B same-bucket graphs'
+    rounds in ONE device call.  Every argument batches over the leading
+    axis; the batch width B is a call-time shape, so each rung of the
+    serving ladder (serve/bucketer.BATCH_LADDER) compiles exactly one
+    executable per (detector, config) through this one cached wrapper.
+    """
+    return jax.jit(jax.vmap(functools.partial(
+        consensus_batch_block, detect=detect, n_p=n_p, tau=tau,
+        delta=delta, n_closure=n_closure, block=block, mode=mode,
+        align_frac=align_frac, sampler=sampler, closure_tau=closure_tau)))
+
+
+@functools.lru_cache(maxsize=64)
+def _jitted_detect_batch(detect: Detector, with_init: bool):
+    """jit(vmap) of a detector over a leading graph-batch axis — the
+    batched analog of :func:`_jitted_detect` for the final re-detection
+    (each element computes ``detect(slab_b, keys_b[, init_b])``, the
+    exact program the solo whole-ensemble dispatch runs)."""
+    if with_init:
+        return jax.jit(jax.vmap(
+            lambda slab, keys, init: detect(slab, keys, init)))
+    return jax.jit(jax.vmap(lambda slab, keys: detect(slab, keys)))
+
+
 @functools.lru_cache(maxsize=128)
 def _jitted_tail(n_p: int, tau: float, delta: float, n_closure: int,
                  mesh=None, sampler: str = "scatter",

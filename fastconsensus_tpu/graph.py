@@ -346,6 +346,31 @@ def grow_slab(slab: GraphSlab, new_capacity: int) -> GraphSlab:
         cap_hint=slab.cap_hint or slab.capacity)
 
 
+def stack_slabs(slabs) -> GraphSlab:
+    """Stack B same-shaped slabs along a new leading batch axis.
+
+    The result is a GraphSlab whose array fields are ``[B, capacity]`` —
+    the operand of the batch-vmapped consensus path (engine.
+    _jitted_rounds_batch).  Every STATIC field (n_nodes, capacity and the
+    sizing metadata) must be identical across the batch: statics are jit
+    cache keys, and the whole point of batching is that same-bucket
+    graphs share one executable (serve/bucketer.py canonicalizes them).
+    """
+    if not slabs:
+        raise ValueError("stack_slabs needs at least one slab")
+    base = slabs[0]
+    statics = lambda s: (s.n_nodes, s.capacity, s.d_cap, s.cap_hint,  # noqa: E731
+                         s.d_hyb, s.hub_cap, s.agg_cap)
+    for i, s in enumerate(slabs[1:], start=1):
+        if statics(s) != statics(base):
+            raise ValueError(
+                f"cannot batch slabs with differing static shapes: slab 0 "
+                f"has {statics(base)}, slab {i} has {statics(s)} "
+                f"(n_nodes, capacity, d_cap, cap_hint, d_hyb, hub_cap, "
+                f"agg_cap); pad through one serve/bucketer bucket first")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *slabs)
+
+
 def host_edges(slab: GraphSlab) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Readback: alive (u, v, w) triples as numpy arrays."""
     src = np.asarray(slab.src)

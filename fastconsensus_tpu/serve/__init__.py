@@ -14,7 +14,9 @@ long-lived service that amortizes everything amortizable:
   content hash — is answered from memory, no device time at all.
 * **serve/queue.py** — bounded thread-safe priority queue with explicit
   admission control: overload is rejected with backpressure (HTTP 429),
-  never absorbed into unbounded growth.
+  never absorbed into unbounded growth.  ``pop_batch`` coalesces queued
+  same-bucket jobs for the cross-request batch path (one vmapped device
+  call per ladder rung, ``consensus.run_consensus_batch``).
 * **serve/jobs.py** — job spec / states / priorities + the content hash.
 * **serve/server.py** — the service core (single device-driving worker)
   and the stdlib HTTP front end: ``POST /submit``, ``GET /status/<id>``,

@@ -49,6 +49,27 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="SECONDS",
                    help="max seconds to finish admitted work on SIGTERM "
                         f"(default {d.drain_timeout_s:.0f})")
+    p.add_argument("--max-batch", type=int, default=d.max_batch,
+                   metavar="B",
+                   help="coalesce up to B queued same-bucket jobs into "
+                        "one batched device call (executed at ladder "
+                        "rungs 1/2/4/8; 1 disables coalescing; default "
+                        f"{d.max_batch})")
+    p.add_argument("--warm", action="append", default=[],
+                   metavar="BUCKET[:B]",
+                   help="pre-warm a bucket's executables before serving "
+                        "(e.g. n64_e96:4 compiles the solo path and the "
+                        "batch ladder up to rung 4); repeatable")
+    p.add_argument("--warm-config", type=str, default=None,
+                   metavar="JSON",
+                   help="ConsensusConfig overrides for --warm probes, "
+                        "e.g. '{\"n_p\": 50, \"algorithm\": \"leiden\"}' "
+                        "(default: louvain with its default tau)")
+    p.add_argument("--cache-file", type=str, default=None, metavar="PATH",
+                   help="persist the result cache across restarts: "
+                        "loaded at startup, spilled (npz) on graceful "
+                        "drain — a restarted server answers repeats of "
+                        "pre-restart work without touching the device")
     p.add_argument("--no-pin-sizing", action="store_true",
                    help="let the engine re-size executables adaptively "
                         "per request (default: pinned — stable bucket "
@@ -76,6 +97,20 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"[fcserve] {msg}", file=sys.stderr, flush=True)
 
     logging.basicConfig(level=logging.WARNING)
+    warm_config = None
+    if args.warm_config:
+        import json
+
+        try:
+            warm_config = json.loads(args.warm_config)
+            if not isinstance(warm_config, dict):
+                raise ValueError("expected a JSON object")
+        except ValueError as e:
+            print(f"error: bad --warm-config: {e}", file=sys.stderr)
+            return 2
+    if args.max_batch < 1:
+        print("error: --max-batch must be >= 1", file=sys.stderr)
+        return 2
     cfg = ServeConfig(queue_depth=args.queue_depth,
                       cache_entries=args.cache_entries,
                       cache_ttl_s=args.cache_ttl,
@@ -83,8 +118,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                       max_edges=args.max_edges,
                       drain_timeout_s=args.drain_timeout,
                       pin_sizing=not args.no_pin_sizing,
-                      trace_dir=args.trace_dir)
+                      trace_dir=args.trace_dir,
+                      max_batch=args.max_batch,
+                      cache_path=args.cache_file,
+                      prewarm=tuple(args.warm),
+                      prewarm_config=warm_config)
     service = ConsensusService(cfg).start()
+    if args.warm:
+        say(f"pre-warming {len(args.warm)} bucket(s): "
+            f"{', '.join(args.warm)}")
     try:
         httpd = make_http_server(service, args.host, args.port)
     except OSError as e:

@@ -52,6 +52,28 @@ from fastconsensus_tpu.graph import GraphSlab, derive_agg_sizing, pack_edges
 MIN_NODE_CLASS = 64
 MIN_EDGE_CLASS = 64
 
+# Cross-request batch ladder: coalesced batches execute only at these
+# widths (serve/server.py splits a coalesced pop into ladder rungs; B=1
+# is the solo path's executables).  The batch width is a leading shape
+# of every batched executable, so an unquantized width would compile a
+# fresh executable per burst size — exactly the hazard the (n, e) grid
+# above exists to prevent, one axis up.  Powers of two cap the split
+# overhead at one extra sub-batch per burst.
+BATCH_LADDER = (1, 2, 4, 8)
+
+
+def batch_rung(n: int) -> int:
+    """Largest batch-ladder rung <= n (>= 1): a coalesced group of n
+    jobs executes as rung-sized sub-batches (8, 4, 2, 1), so the
+    resident executable set stays at most ``len(BATCH_LADDER)`` wide per
+    (bucket, config) and CompileGuard can pin it."""
+    n = max(int(n), 1)
+    rung = BATCH_LADDER[0]
+    for b in BATCH_LADDER:
+        if b <= n:
+            rung = b
+    return rung
+
 
 class BucketTooLarge(ValueError):
     """Admission refused: the graph exceeds the configured ladder top
@@ -72,7 +94,18 @@ class Bucket:
 
     @property
     def agg_cap(self) -> int:
-        return derive_agg_sizing(self.e_class)
+        # Sized from the slab CAPACITY, not e_class: alive edges are
+        # bounded by capacity, so this compaction budget can never
+        # starve (policy.budgets_stale's agg term needs alive >
+        # 1.25*agg_cap, and derive_agg_sizing(capacity) > capacity) —
+        # a mid-run budget re-derivation would re-size a shared bucket
+        # executable, the exact compile hazard the canonical statics
+        # exist to prevent, and it forces the batch path to split jobs
+        # off to solo tails.  Costs a ~2x-generous aggregate hash slab
+        # vs content-derived sizing; serving trades that for executable
+        # stability.  (e_class-derived sizing starved in practice:
+        # lfr1k-density graphs run alive ~10.3k against the old 8192.)
+        return derive_agg_sizing(self.capacity)
 
     @property
     def n_closure(self) -> int:
@@ -108,6 +141,65 @@ def bucket_for(n_nodes: int, n_edges: int,
                   e_class=sizing.grid_up(n_edges, MIN_EDGE_CLASS))
 
 
+def bucket_from_key(key: str) -> Bucket:
+    """Parse a bucket key back into its Bucket (``"n64_e96"`` — the
+    ``--warm`` flag's operand).  Classes must sit exactly on the ladder
+    grid: a typo'd class would pre-warm executables no request can ever
+    land on, silently."""
+    try:
+        n_part, e_part = key.split("_")
+        if not (n_part.startswith("n") and e_part.startswith("e")):
+            raise ValueError
+        n_class, e_class = int(n_part[1:]), int(e_part[1:])
+    except ValueError:
+        raise ValueError(
+            f"bad bucket key {key!r}; expected the form n<N>_e<E>, e.g. "
+            f"n64_e96") from None
+    want = bucket_for(n_class, e_class)
+    got = Bucket(n_class=n_class, e_class=e_class)
+    if want != got:
+        raise ValueError(
+            f"bucket key {key!r} is not on the ladder grid; the "
+            f"nearest real bucket is {want.key()}")
+    return got
+
+
+def probe_edges(bucket: Bucket, variant: int = 0) -> np.ndarray:
+    """A deterministic synthetic graph landing EXACTLY in ``bucket``:
+    ``n_class`` nodes, ``e_class`` canonical edges (a path over the
+    first nodes plus chord families).  ``variant`` shifts the chords so
+    pre-warm batches carry genuinely distinct graphs per batch lane —
+    the shapes are what compile, but distinct content keeps the probe
+    honest about the per-job PRNG/cache paths."""
+    n, e = bucket.n_class, bucket.e_class
+    seen = set()
+    rows = []
+
+    def add(u: int, v: int) -> None:
+        if u == v:
+            return
+        k = (min(u, v), max(u, v))
+        if k in seen:
+            return
+        seen.add(k)
+        rows.append(k)
+
+    # chord-less buckets (e <= n-1) vary by shifting the path's start
+    # node instead; chordful ones keep the path fixed and shift chords
+    off = (variant % n) if e <= n - 1 else 0
+    for i in range(min(e, n - 1)):
+        add((off + i) % n, (off + i + 1) % n)
+    shift, i = 2 + (variant % max(n - 3, 1)), 0
+    while len(rows) < e:
+        add(i, (i + shift) % n)
+        i += 1
+        if i >= n:
+            i, shift = 0, shift + 1
+            if shift >= n:  # pragma: no cover — e_class <= n*(n-1)/2
+                raise ValueError(f"cannot realize {e} edges on {n} nodes")
+    return np.asarray(rows, dtype=np.int64)
+
+
 def pad_to_bucket(edges: np.ndarray, n_nodes: int,
                   weights: Optional[np.ndarray] = None,
                   max_nodes: Optional[int] = None,
@@ -131,6 +223,12 @@ def pad_to_bucket(edges: np.ndarray, n_nodes: int,
 
         canonical = canonical_edges(edges, n_nodes, weights)
     u, v, w = canonical
+    if w is not None and not np.all(np.isfinite(w)):
+        # A NaN/inf weight is malformed input, not a computable job —
+        # reject it HERE (per graph, before any batch is stacked) so a
+        # coalesced batch fails only the poisoned member, never its
+        # batchmates (serve/server.py failure isolation).
+        raise ValueError("graph carries non-finite edge weights")
     bucket = bucket_for(n_nodes, int(u.shape[0]),
                         max_nodes=max_nodes, max_edges=max_edges)
     slab = pack_edges(np.stack([u, v], axis=1), bucket.n_class,

@@ -96,3 +96,103 @@ class ResultCache:
     def keys(self) -> list:
         with self._lock:
             return list(self._entries)
+
+    # -- persistence across restarts (npz spill / reload) -------------
+    #
+    # A restarted server otherwise starts cold: every request recomputes
+    # until the cache refills.  Result payloads are plain numpy (the
+    # partitions) plus JSON scalars, so the utils/checkpoint-style npz
+    # spill captures them losslessly.  TTL survives the restart as
+    # REMAINING lifetime: the monotonic stored_at clock is meaningless
+    # across processes, so each entry persists its *age* at spill time
+    # and re-enters the new process's clock with that age pre-spent.
+
+    def spill(self, path: str) -> int:
+        """Write every live (unexpired) entry to ``path`` (npz,
+        atomic); returns the number spilled.  Entries whose payload is
+        not the standard result shape (a dict with a ``partitions``
+        array list and JSON scalars) are skipped with a counter — the
+        spill must never fail the drain that triggers it."""
+        import json
+
+        import numpy as np
+
+        now = self._clock()
+        with self._lock:
+            items = [(k, t, v) for k, (t, v) in self._entries.items()]
+        meta, arrays = [], {}
+        for key, stored_at, value in items:
+            age = now - stored_at
+            if age > self.ttl_seconds:
+                continue
+            try:
+                payload = dict(value)
+                parts = payload.pop("partitions")
+                # fcheck: ok=sync-in-loop (cached partitions are host
+                # numpy already — this is pure serialization, no device)
+                arr = np.stack([np.asarray(p, dtype=np.int32)
+                                for p in parts])
+                json.dumps(payload)  # everything else must be JSON
+            except (TypeError, ValueError, KeyError):
+                self._reg.inc("serve.cache.persist_skipped")
+                continue
+            idx = len(meta)
+            arrays[f"p{idx}"] = arr
+            meta.append({"key": key, "age": age, "payload": payload})
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, meta=np.frombuffer(
+                json.dumps(meta).encode("utf-8"), dtype=np.uint8),
+                **arrays)
+        import os
+
+        os.replace(tmp, path)
+        self._reg.inc("serve.cache.persist_saved", len(meta))
+        return len(meta)
+
+    def load(self, path: str) -> int:
+        """Reload a :meth:`spill` artifact into this cache (LRU order
+        preserved; entries past their remaining TTL are dropped).
+        Returns the number loaded; missing/corrupt files load nothing
+        (a cold start, not a crash — counted)."""
+        import json
+
+        import numpy as np
+
+        try:
+            with np.load(path) as z:
+                meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+                loaded = 0
+                now = self._clock()
+                for idx, ent in enumerate(meta):
+                    if ent["age"] > self.ttl_seconds:
+                        self._reg.inc("serve.cache.persist_expired")
+                        continue
+                    arr = z[f"p{idx}"]
+                    value = dict(ent["payload"])
+                    value["partitions"] = [arr[i].copy()
+                                           for i in range(arr.shape[0])]
+                    with self._lock:
+                        self._entries[ent["key"]] = (now - ent["age"],
+                                                     value)
+                        self._entries.move_to_end(ent["key"])
+                        while len(self._entries) > self.max_entries:
+                            self._entries.popitem(last=False)
+                            self._reg.inc("serve.cache.evict_lru")
+                    loaded += 1
+        except Exception as e:  # noqa: BLE001 — the persistence
+            # contract is "corrupt or missing file means a cold start,
+            # never a crash": np.load surfaces OSError/ValueError for
+            # most damage but zipfile.BadZipFile/EOFError for truncated
+            # archives, and server startup must survive ALL of them
+            self._reg.inc("serve.cache.persist_load_failed")
+            import logging
+
+            logging.getLogger("fastconsensus_tpu").warning(
+                "result-cache reload from %s failed (%s); starting cold",
+                path, e)
+            return 0
+        with self._lock:
+            self._reg.gauge("serve.cache.entries", len(self._entries))
+        self._reg.inc("serve.cache.persist_loaded", loaded)
+        return loaded

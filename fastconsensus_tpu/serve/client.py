@@ -107,6 +107,25 @@ class ServeClient:
     def metricsz(self) -> Dict[str, Any]:
         return self._request("/metricsz")
 
+    def coalescing(self) -> Dict[str, Any]:
+        """Operator view of cross-request batching, extracted from
+        ``/metricsz``: how many batched device calls ran
+        (``serve.batch.coalesced``), how many jobs rode in them
+        (``serve.batch.occupancy``), the mean occupancy, and the solo
+        fallback count.  ``/status/<id>`` of any coalesced job also
+        carries its ``batch_id``/``batch_size``."""
+        counters = self.metricsz().get("fcobs", {}).get("counters", {})
+        batches = counters.get("serve.batch.coalesced", 0)
+        jobs = counters.get("serve.batch.occupancy", 0)
+        return {
+            "batches": batches,
+            "jobs_coalesced": jobs,
+            "mean_occupancy": round(jobs / batches, 3) if batches else 0.0,
+            "solo_fallbacks": counters.get("serve.batch.fallback_solo", 0),
+            "queue_coalesced_pops": counters.get(
+                "serve.queue.coalesced_pops", 0),
+        }
+
     def wait(self, job_id: str, timeout: float = 300.0,
              poll_s: float = 0.2) -> Dict[str, Any]:
         """Poll until the job finishes; returns the result payload.

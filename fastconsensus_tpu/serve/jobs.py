@@ -148,6 +148,29 @@ class JobSpec:
         return hash_canonical(self.canonical(), self.n_nodes,
                               self.config)
 
+    def batch_group(self) -> str:
+        """Coalescing key for cross-request batching (serve/queue.py
+        ``pop_batch``): two jobs may share one batched device call iff
+        they land in the same shape bucket AND run the same config in
+        every field but the seed.  The seed is excluded deliberately —
+        it reaches the engine as a traced PRNG key (per-job, never
+        per-batch), so distinct seeds share executables and results stay
+        bit-identical to solo runs (run_consensus_batch contract).
+        Memoized: pop_batch evaluates it under the queue lock.
+        """
+        cached = getattr(self, "_batch_group", None)
+        if cached is None:
+            from fastconsensus_tpu.serve import bucketer
+
+            u, _, _ = self.canonical()
+            bucket = bucketer.bucket_for(self.n_nodes,
+                                         max(int(u.shape[0]), 1))
+            cfg = dataclasses.replace(self.config, seed=0)
+            cached = f"{bucket.key()}|" \
+                     f"{repr(dataclasses.astuple(cfg))}"
+            object.__setattr__(self, "_batch_group", cached)
+        return cached
+
 
 class Job:
     """One submission's mutable lifecycle record.
@@ -167,7 +190,17 @@ class Job:
         self.finished_at: Optional[float] = None
         self.error: Optional[str] = None
         self.result: Optional[Dict[str, Any]] = None
+        # Cross-request batching metadata (serve/server.py): set when
+        # the worker coalesces this job into a batched device call.
+        # batch_size stays 1 for solo execution.
+        self.batch_id: Optional[str] = None
+        self.batch_size: int = 1
         self._lock = threading.Lock()
+
+    def set_batch(self, batch_id: str, batch_size: int) -> None:
+        with self._lock:
+            self.batch_id = batch_id
+            self.batch_size = int(batch_size)
 
     def mark(self, state: str, result: Optional[Dict[str, Any]] = None,
              error: Optional[str] = None) -> None:
@@ -198,4 +231,6 @@ class Job:
                 "started_at": self.started_at,
                 "finished_at": self.finished_at,
                 "error": self.error,
+                "batch_id": self.batch_id,
+                "batch_size": self.batch_size,
             }

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import heapq
 import threading
-from typing import List, Optional, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from fastconsensus_tpu.obs import counters as obs_counters
 from fastconsensus_tpu.serve.jobs import Job
@@ -89,6 +89,52 @@ class AdmissionQueue:
                     _, _, job = heapq.heappop(self._heap)
                     self._reg.gauge("serve.queue.depth", len(self._heap))
                     return job
+                if self._closed:
+                    return None
+                if not self._cond.wait(timeout):
+                    return None
+
+    def pop_batch(self, max_b: int,
+                  group_key: Callable[[Job], str],
+                  timeout: Optional[float] = None
+                  ) -> Optional[List[Job]]:
+        """The next job plus up to ``max_b - 1`` already-queued jobs of
+        the same batch group (serve/jobs.JobSpec.batch_group) — the
+        cross-request coalescing pop.
+
+        Priority is never starved: the HEAD is always the strict
+        ``(priority, seq)`` front of the queue, coalescing only pulls
+        *ride-along* jobs that would otherwise run later, and it never
+        waits for more work to arrive — a lone job pops immediately as a
+        batch of one.  A job skipped over by a ride-along is delayed by
+        at most the one coalesced device call, which costs about what
+        the head job alone would have (that amortization is the whole
+        point); it pops next.
+
+        Same drain semantics as :meth:`pop`: ``None`` once the queue is
+        closed *and* empty (or on ``timeout`` with nothing queued).
+        """
+        with self._cond:
+            while True:
+                if self._heap:
+                    _, _, head = heapq.heappop(self._heap)
+                    taken = [head]
+                    if max_b > 1 and self._heap:
+                        g = group_key(head)
+                        rest: List[Tuple[int, int, Job]] = []
+                        # sorted() of a heap is a valid heap, and gives
+                        # ride-alongs in strict (priority, seq) order
+                        for entry in sorted(self._heap):
+                            if len(taken) < max_b and \
+                                    group_key(entry[2]) == g:
+                                taken.append(entry[2])
+                            else:
+                                rest.append(entry)
+                        self._heap = rest
+                        if len(taken) > 1:
+                            self._reg.inc("serve.queue.coalesced_pops")
+                    self._reg.gauge("serve.queue.depth", len(self._heap))
+                    return taken
                 if self._closed:
                     return None
                 if not self._cond.wait(timeout):

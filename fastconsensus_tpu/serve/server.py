@@ -9,6 +9,11 @@ process whose jitted executables are reused across requests:
   so distinct graphs share executables and warm-bucket requests compile
   **zero** times (counted live by ``analysis.CompileGuard`` into the
   fcobs registry — ``/metricsz`` shows it);
+* queued same-bucket jobs COALESCE: the worker pops up to ``max_batch``
+  same-group jobs at once (serve/queue.pop_batch) and drives them as
+  ONE batched device call (consensus.run_consensus_batch) at batch-
+  ladder rungs {1, 2, 4, 8}, bit-identical per job to solo execution;
+  ``--warm`` pre-compiles a bucket's ladder before the first request;
 * identical work is answered from a content-addressed LRU+TTL result
   cache (serve/cache.py) without touching the device at all;
 * admission control is explicit: a bounded priority queue
@@ -35,14 +40,15 @@ client side): no new dependencies ride in with the subsystem.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import logging
 import os
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -101,6 +107,30 @@ class ServeConfig:
     # resident server observes per-job/per-round latencies forever, and
     # unbounded sample lists are a slow leak.  0/None disables.
     series_window: Optional[int] = 4096
+    # Cross-request batching: the worker coalesces up to this many
+    # queued same-group jobs (same bucket, same config-but-seed —
+    # jobs.JobSpec.batch_group) into ONE batched device call
+    # (consensus.run_consensus_batch), executed at batch-ladder rungs
+    # (bucketer.BATCH_LADDER) so the executable set stays pinnable.
+    # 1 disables coalescing (every job runs solo).
+    max_batch: int = 8
+    # Persist the content-addressed result cache across restarts: loaded
+    # at start(), spilled on graceful drain (ResultCache.spill/load).
+    # A restarted server answers repeats of pre-restart work as cache
+    # hits without touching the device.  None = in-memory only.
+    cache_path: Optional[str] = None
+    # Pre-warm bucket specs ("n64_e96" or "n64_e96:4"): before serving,
+    # the worker compiles each bucket's solo executables and its batch
+    # ladder up to the given rung (default: max_batch) by driving
+    # deterministic probe graphs through the real paths — the first
+    # request into a warmed bucket compiles nothing.
+    prewarm: Tuple[str, ...] = ()
+    # ConsensusConfig field overrides for the pre-warm probes (e.g.
+    # {"n_p": 50, "algorithm": "leiden"}).  Executable identity includes
+    # n_p / tau / delta / algorithm / gamma / warm_start / align_frac /
+    # closure_sampler / closure_tau, so pre-warm only pays off when
+    # these match the traffic; seed and max_rounds are traced and free.
+    prewarm_config: Optional[Dict[str, Any]] = None
 
 
 class ConsensusService:
@@ -120,6 +150,10 @@ class ConsensusService:
         self._buckets: Dict[str, int] = {}
         self._started_at = time.time()
         self._reg = obs_counters.get_registry()
+        self._batch_seq = itertools.count(1)
+        self._prewarm_total = len(self.config.prewarm)
+        self._prewarm_done = 0
+        self._prewarm_finished = self._prewarm_total == 0
 
     # -- lifecycle ---------------------------------------------------
 
@@ -141,6 +175,11 @@ class ConsensusService:
             open(self._trace_jsonl, "w", encoding="utf-8").close()
             self._tracer = Tracer()
             set_tracer(self._tracer)
+        if self.config.cache_path and \
+                os.path.exists(self.config.cache_path):
+            n = self.cache.load(self.config.cache_path)
+            _logger.info("fcserve: reloaded %d cached result(s) from %s",
+                         n, self.config.cache_path)
         self._worker = threading.Thread(target=self._worker_loop,
                                         name="fcserve-worker", daemon=True)
         self._worker.start()
@@ -160,6 +199,10 @@ class ConsensusService:
                               else self.config.drain_timeout_s)
             ok = not self._worker.is_alive()
         if ok:
+            if self.config.cache_path:
+                n = self.cache.spill(self.config.cache_path)
+                _logger.info("fcserve: spilled %d cached result(s) to %s",
+                             n, self.config.cache_path)
             self._export_trace()
         else:
             # the worker is STILL RUNNING a job: exporting now would
@@ -238,6 +281,16 @@ class ConsensusService:
             self._remember(job)
             self._reg.inc("serve.jobs.cached")
             return job
+        try:
+            # Pre-compute (memoize) the coalescing group HERE, on the
+            # submitting thread: pop_batch evaluates group_key under
+            # the queue lock, and a first evaluation there would run
+            # the O(E log E) canonicalization for every heap entry
+            # while all submits block.  (canonical() is already warm —
+            # the content hash above computed it.)
+            job.spec.batch_group()
+        except Exception:  # noqa: BLE001 — grouping must never reject
+            pass           # a job; _group_key falls back to solo
         self.queue.submit(job)   # QueueFull/QueueClosed propagate
         self._remember(job)
         return job
@@ -281,23 +334,266 @@ class ConsensusService:
     # -- the worker --------------------------------------------------
 
     def _worker_loop(self) -> None:
+        self._prewarm_all()
         while True:
-            job = self.queue.pop()
-            if job is None:
+            batch = self.queue.pop_batch(self.config.max_batch,
+                                         group_key=self._group_key)
+            if batch is None:
                 return  # queue closed and drained
-            job.mark(STATE_RUNNING)
-            try:
-                result = self.run_spec(job.spec, key=job.key)
-                job.mark(STATE_DONE, result=result)
-                self._reg.inc("serve.jobs.completed")
-            except Exception as e:  # noqa: BLE001 — one bad job must
-                # never take down the worker (and with it every queued
-                # job behind it); the failure is the job's result
-                job.mark(STATE_FAILED, error=f"{type(e).__name__}: {e}")
-                self._reg.inc("serve.jobs.failed")
-                _logger.warning("fcserve job %s failed: %s", job.job_id,
-                                job.error)
+            self._drain_group(deque(batch))
             self._flush_trace()
+
+    def _group_key(self, job: Job) -> str:
+        try:
+            return job.spec.batch_group()
+        except Exception:  # noqa: BLE001 — a spec the bucketer rejects
+            # must still pop (and fail as ITS OWN job, solo); a unique
+            # group key guarantees it never coalesces
+            return f"solo:{job.job_id}"
+
+    def _drain_group(self, pending: "deque[Job]") -> None:
+        """Run one coalesced pop: answer cache hits, then execute the
+        rest at batch-ladder rungs (one batched device call per rung,
+        solo for a rung of 1)."""
+        runnable: List[Job] = []
+        for job in pending:
+            cached = self.cache.get(job.key, count_miss=False)
+            if cached is not None:
+                # an identical job finished while this one queued — a
+                # genuine serve, same accounting as the solo re-probe
+                job.mark(STATE_DONE, result=dict(cached, cached=True))
+                self._reg.inc("serve.jobs.completed")
+            else:
+                runnable.append(job)
+        while runnable:
+            rung = bucketer.batch_rung(min(len(runnable),
+                                           self.config.max_batch))
+            chunk, runnable = runnable[:rung], runnable[rung:]
+            if len(chunk) == 1:
+                self._run_solo_job(chunk[0])
+            else:
+                self._run_batch(chunk)
+
+    def _run_solo_job(self, job: Job) -> None:
+        job.mark(STATE_RUNNING)
+        try:
+            result = self.run_spec(job.spec, key=job.key)
+            job.mark(STATE_DONE, result=result)
+            self._reg.inc("serve.jobs.completed")
+        except Exception as e:  # noqa: BLE001 — one bad job must
+            # never take down the worker (and with it every queued
+            # job behind it); the failure is the job's result
+            job.mark(STATE_FAILED, error=f"{type(e).__name__}: {e}")
+            self._reg.inc("serve.jobs.failed")
+            _logger.warning("fcserve job %s failed: %s", job.job_id,
+                            job.error)
+
+    def _run_batch(self, jobs: List[Job]) -> None:
+        """Execute >= 2 same-group jobs as ONE batched device call.
+
+        Failure isolation, in order: a job whose graph fails to pack
+        (e.g. non-finite weights) fails alone at pack time, before any
+        batch exists; if the batched call itself raises, every member
+        falls back to solo execution so one poison job cannot fail its
+        batchmates.  Per-job spans, cache fills and counters fan out of
+        the shared call.
+        """
+        packed: List[Tuple] = []  # (job, normalized spec, slab, bucket)
+        for job in jobs:
+            job.mark(STATE_RUNNING)
+            spec = self._normalize_spec(job.spec)
+            try:
+                slab, bucket = bucketer.pad_to_bucket(
+                    spec.edges, spec.n_nodes, spec.weights,
+                    max_nodes=self.config.max_nodes,
+                    max_edges=self.config.max_edges,
+                    canonical=spec.canonical())
+            except Exception as e:  # noqa: BLE001 — pack-time rejects
+                job.mark(STATE_FAILED,
+                         error=f"{type(e).__name__}: {e}")
+                self._reg.inc("serve.jobs.failed")
+                _logger.warning("fcserve job %s failed at pack: %s",
+                                job.job_id, job.error)
+                continue
+            packed.append((job, spec, slab, bucket))
+        # pack failures can leave an off-ladder width; re-split so
+        # every device call stays on a BATCH_LADDER rung (the
+        # executable-set pin)
+        while packed:
+            rung = bucketer.batch_rung(len(packed))
+            chunk, packed = packed[:rung], packed[rung:]
+            if len(chunk) == 1:
+                self._run_solo_job(chunk[0][0])
+            else:
+                self._run_packed(chunk)
+
+    def _run_packed(self, packed: List[Tuple]) -> None:
+        """One batched device call over already-packed (job, spec, slab,
+        bucket) rows (a ladder rung of >= 2)."""
+        from fastconsensus_tpu.analysis import CompileGuard
+        from fastconsensus_tpu.consensus import run_consensus_batch
+        from fastconsensus_tpu.models.registry import get_detector
+
+        batch_id = f"b{next(self._batch_seq):05d}"
+        bucket = packed[0][3]
+        cfg0 = packed[0][1].config
+        seeds = [spec.config.seed for _, spec, _, _ in packed]
+        detect = get_detector(cfg0.algorithm, gamma=cfg0.gamma)
+        tracer = get_tracer()
+        t0 = time.perf_counter()
+        guard = CompileGuard(registry=self._reg,
+                             counter="serve.xla_compiles")
+        try:
+            with tracer.span("serve.batch", bucket=bucket.key(),
+                             alg=cfg0.algorithm, b=len(packed),
+                             batch_id=batch_id):
+                with guard:
+                    results = run_consensus_batch(
+                        [slab for _, _, slab, _ in packed], detect,
+                        cfg0, n_closure=bucket.n_closure, seeds=seeds)
+        except Exception as e:  # noqa: BLE001 — whole-batch failure:
+            # isolate by re-running every member solo; only genuinely
+            # bad jobs fail, each as itself
+            _logger.warning("fcserve batch %s failed (%s); retrying "
+                            "members solo", batch_id, e)
+            self._reg.inc("serve.batch.fallback_solo")
+            for job, _, _, _ in packed:
+                self._run_solo_job(job)
+            return
+        elapsed = time.perf_counter() - t0
+        # batch metadata and coalescing metrics record only batches
+        # that actually COMPLETED as a batch: stamping before the call
+        # would leave fallback-solo jobs advertising a coalesced run
+        # that never happened
+        for job, _, _, _ in packed:
+            job.set_batch(batch_id, len(packed))
+        self._reg.inc("serve.batch.coalesced")
+        self._reg.inc("serve.batch.occupancy", len(packed))
+        self._reg.gauge("serve.batch.last_size", len(packed))
+        self._reg.observe("serve.batch.seconds", elapsed)
+        for (job, spec, _, _), res in zip(packed, results):
+            with tracer.span("serve.job", bucket=bucket.key(),
+                             alg=cfg0.algorithm, batch_id=batch_id):
+                result = self._finish_result(
+                    spec, job.key, bucket, res.partitions,
+                    rounds=res.rounds, converged=res.converged,
+                    compiles=guard.count, elapsed=elapsed,
+                    batch_id=batch_id, batch_size=len(packed))
+            job.mark(STATE_DONE, result=result)
+            self._reg.inc("serve.jobs.completed")
+            self._reg.observe("serve.job.seconds", elapsed / len(packed))
+
+    def _finish_result(self, spec: JobSpec, key: str, bucket,
+                       partitions_raw, rounds: int, converged: bool,
+                       compiles: int, elapsed: float,
+                       batch_id: Optional[str] = None,
+                       batch_size: int = 1) -> Dict[str, Any]:
+        """Slice off bucket padding, recompact ids, fill the cache —
+        the shared tail of the solo and batched execution paths."""
+        partitions = []
+        for p in partitions_raw:
+            # fcheck: ok=sync-in-loop (partitions are already host numpy
+            # — the engine does its one bulk readback; this loop only
+            # slices off the bucket's padding nodes and recompacts ids)
+            lab = np.asarray(p)[: spec.n_nodes]
+            _, compact = np.unique(lab, return_inverse=True)
+            partitions.append(compact.astype(np.int32))
+        result = {
+            "content_hash": key,
+            "bucket": bucket.describe(),
+            "partitions": partitions,
+            "n_nodes": spec.n_nodes,
+            "rounds": rounds,
+            "converged": converged,
+            "compiles": compiles,
+            "elapsed_s": round(elapsed, 6),
+            "cached": False,
+        }
+        if batch_id is not None:
+            result["batch_id"] = batch_id
+            result["batch_size"] = batch_size
+        self.cache.put(key, result)
+        with self._lock:
+            self._buckets[bucket.key()] = \
+                self._buckets.get(bucket.key(), 0) + 1
+        return result
+
+    # -- pre-warm ----------------------------------------------------
+
+    def _prewarm_all(self) -> None:
+        for spec in self.config.prewarm:
+            try:
+                self._prewarm_one(spec)
+            except Exception as e:  # noqa: BLE001 — a bad warm spec
+                # must not kill the worker before it served anything
+                self._reg.inc("serve.prewarm.failed")
+                _logger.warning("fcserve pre-warm %r failed: %s", spec, e)
+            self._prewarm_done += 1
+        self._prewarm_finished = True
+
+    def _prewarm_one(self, spec: str) -> None:
+        """Compile one bucket's executables before the first request:
+        ``"n64_e96"`` warms the solo path, ``"n64_e96:4"`` also the
+        batch ladder up to rung 4 — deterministic probe graphs driven
+        through the REAL solo/batched paths (results discarded, cache
+        untouched), compiles counted under ``serve.prewarm.compiles``.
+        """
+        from fastconsensus_tpu.analysis import CompileGuard
+        from fastconsensus_tpu.consensus import (ConsensusConfig,
+                                                 run_consensus,
+                                                 run_consensus_batch)
+        from fastconsensus_tpu.models.registry import get_detector
+
+        key, _, b = spec.partition(":")
+        max_b = self.config.max_batch
+        if b:
+            if int(b) < 1:
+                # a 0-rung spec would compile nothing yet count the
+                # bucket as warmed — the silent no-op bucket_from_key's
+                # grid check exists to prevent, one knob over
+                raise ValueError(
+                    f"--warm {spec!r}: rung must be >= 1")
+            max_b = min(int(b), self.config.max_batch)
+        bucket = bucketer.bucket_from_key(key)
+        # tau defaults from the RESOLVED algorithm, mirroring the
+        # request path (_parse_spec's DEFAULT_TAU[alg] setdefault): tau
+        # is a jit-static, so a louvain-tau probe for an infomap warm
+        # spec would compile executables no request ever lands on
+        cfg_kwargs = dict({"algorithm": "louvain"},
+                          **(self.config.prewarm_config or {}))
+        cfg_kwargs.setdefault("tau", DEFAULT_TAU[cfg_kwargs["algorithm"]])
+        cfg = ConsensusConfig(**cfg_kwargs)
+        detect = get_detector(cfg.algorithm, gamma=cfg.gamma)
+        tracer = get_tracer()
+        t0 = time.perf_counter()
+        guard = CompileGuard(registry=self._reg,
+                             counter="serve.prewarm.compiles")
+        with tracer.span("serve.prewarm", bucket=bucket.key(),
+                         alg=cfg.algorithm, max_b=max_b):
+            with guard:
+                for rung in bucketer.BATCH_LADDER:
+                    if rung > max_b:
+                        break
+                    # distinct probe content per lane: shapes are what
+                    # compile, but distinct graphs keep the probe honest
+                    slabs = []
+                    for v in range(rung):
+                        slab, _ = bucketer.pad_to_bucket(
+                            bucketer.probe_edges(bucket, variant=v),
+                            bucket.n_class)
+                        slabs.append(slab)
+                    if rung == 1:
+                        run_consensus(slabs[0], detect, cfg,
+                                      n_closure=bucket.n_closure)
+                    else:
+                        run_consensus_batch(
+                            slabs, detect, cfg,
+                            n_closure=bucket.n_closure,
+                            seeds=list(range(rung)))
+        self._reg.inc("serve.prewarm.buckets")
+        _logger.info(
+            "fcserve pre-warmed %s ladder to B=%d (%d compiles, %.1fs)",
+            bucket.key(), max_b, guard.count, time.perf_counter() - t0)
 
     def run_spec(self, spec: JobSpec,
                  key: Optional[str] = None) -> Dict[str, Any]:
@@ -340,29 +636,11 @@ class ConsensusService:
                 res = run_consensus(slab, detect, spec.config,
                                     n_closure=bucket.n_closure)
         elapsed = time.perf_counter() - t0
-        partitions = []
-        for p in res.partitions:
-            # fcheck: ok=sync-in-loop (partitions are already host numpy
-            # — run_consensus does its one bulk readback; this loop only
-            # slices off the bucket's padding nodes and recompacts ids)
-            lab = np.asarray(p)[: spec.n_nodes]
-            _, compact = np.unique(lab, return_inverse=True)
-            partitions.append(compact.astype(np.int32))
-        result = {
-            "content_hash": key,
-            "bucket": bucket.describe(),
-            "partitions": partitions,
-            "n_nodes": spec.n_nodes,
-            "rounds": res.rounds,
-            "converged": res.converged,
-            "compiles": guard.count,
-            "elapsed_s": round(elapsed, 6),
-            "cached": False,
-        }
-        self.cache.put(key, result)
-        with self._lock:
-            self._buckets[bucket.key()] = \
-                self._buckets.get(bucket.key(), 0) + 1
+        result = self._finish_result(spec, key, bucket, res.partitions,
+                                     rounds=res.rounds,
+                                     converged=res.converged,
+                                     compiles=guard.count,
+                                     elapsed=elapsed)
         self._reg.observe("serve.job.seconds", elapsed)
         return result
 
@@ -382,6 +660,10 @@ class ConsensusService:
             "cache_entries": len(self.cache),
             "jobs": states,
             "buckets": buckets,
+            "max_batch": self.config.max_batch,
+            "prewarm": {"specs": self._prewarm_total,
+                        "done": self._prewarm_done,
+                        "finished": self._prewarm_finished},
         }
 
 

@@ -180,6 +180,135 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== fcserve: batching smoke (pre-warm, coalescing, cache restart) =="
+BATCH_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR" "$SERVE_DIR" "$BATCH_DIR"; [ -n "$SERVE_PID" ] && kill -9 "$SERVE_PID" 2>/dev/null' EXIT
+BATCH_PORT=$(python - <<'PYEOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+PYEOF
+)
+# --warm n64_e96:4 compiles the karate-sized bucket's solo path + batch
+# ladder BEFORE the first request; --cache-file persists results across
+# the restart below.  warm-config matches the burst's config (n_p=4).
+JAX_PLATFORMS=cpu python -m fastconsensus_tpu.serve --host 127.0.0.1 \
+    --port "$BATCH_PORT" --queue-depth 16 --max-batch 4 \
+    --warm n64_e96:4 --warm-config '{"n_p": 4, "max_rounds": 2}' \
+    --cache-file "$BATCH_DIR/cache.npz" --quiet &
+SERVE_PID=$!
+JAX_PLATFORMS=cpu python - "$BATCH_PORT" <<'PYEOF'
+import sys
+import time
+
+from fastconsensus_tpu.serve.client import ServeClient
+from fastconsensus_tpu.utils.io import read_edgelist
+
+client = ServeClient(f"http://127.0.0.1:{int(sys.argv[1])}", timeout=30.0)
+for _ in range(600):   # jax import + pre-warm compiles take a while
+    try:
+        if client.healthz().get("prewarm", {}).get("finished"):
+            break
+    except Exception:
+        pass
+    time.sleep(0.5)
+else:
+    sys.exit("fcserve never finished pre-warming")
+m = client.metricsz()["fcobs"]["counters"]
+# a --warm startup compiles BEFORE the first request...
+assert m.get("serve.prewarm.compiles", 0) > 0, m
+# ...and no request has compiled anything yet
+assert m.get("serve.xla_compiles", 0) == 0, m
+edges, _, ids = read_edgelist("examples/karate_club.txt")
+# Stall the worker on a fresh shape (n_p=8 compiles for seconds), then
+# burst 4 same-bucket jobs at the WARMED config — they queue together
+# and must coalesce into >= 1 batched call.
+stall = client.submit(edges=edges.tolist(), n_nodes=len(ids),
+                      algorithm="louvain", n_p=8, max_rounds=2, seed=99)
+subs = [client.submit(edges=edges.tolist(), n_nodes=len(ids),
+                      algorithm="louvain", n_p=4, max_rounds=2, seed=s)
+        for s in range(1, 5)]
+client.wait(stall["job_id"], timeout=300)
+for s in subs:
+    client.wait(s["job_id"], timeout=300)
+co = client.coalescing()
+assert co["batches"] >= 1, co
+assert co["jobs_coalesced"] >= 2, co
+st = client.status(subs[0]["job_id"])
+assert st["batch_size"] >= 2 and st["batch_id"], st
+# the warmed-bucket burst compiled NOTHING (per-job compile counts; the
+# stall job, a fresh n_p=8 shape, owns its own compiles)
+for s in subs:
+    r = client.result(s["job_id"])
+    assert r.get("compiles", -1) == 0, (s, r.get("compiles"))
+print(f"fcserve batching smoke ok: {co['batches']} coalesced batch(es), "
+      f"{co['jobs_coalesced']} jobs coalesced, "
+      f"prewarm_compiles={m.get('serve.prewarm.compiles')}")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcserve batching smoke failed (exit $rc)" >&2
+    exit $rc
+fi
+kill -TERM "$SERVE_PID"
+wait "$SERVE_PID"
+rc=$?
+SERVE_PID=""
+if [ $rc -ne 0 ]; then
+    echo "fcserve (batching) did not drain cleanly on SIGTERM (exit $rc)" >&2
+    exit $rc
+fi
+if [ ! -s "$BATCH_DIR/cache.npz" ]; then
+    echo "fcserve drain did not spill the result cache" >&2
+    exit 1
+fi
+# Restart with the persisted cache: a repeat request must be a HIT at
+# submit time — no queue, no device call, no compiles.
+JAX_PLATFORMS=cpu python -m fastconsensus_tpu.serve --host 127.0.0.1 \
+    --port "$BATCH_PORT" --cache-file "$BATCH_DIR/cache.npz" --quiet &
+SERVE_PID=$!
+JAX_PLATFORMS=cpu python - "$BATCH_PORT" <<'PYEOF'
+import sys
+import time
+
+from fastconsensus_tpu.serve.client import ServeClient
+from fastconsensus_tpu.utils.io import read_edgelist
+
+client = ServeClient(f"http://127.0.0.1:{int(sys.argv[1])}", timeout=30.0)
+for _ in range(300):
+    try:
+        client.healthz()
+        break
+    except Exception:
+        time.sleep(0.2)
+else:
+    sys.exit("restarted fcserve never came up")
+edges, _, ids = read_edgelist("examples/karate_club.txt")
+sub = client.submit(edges=edges.tolist(), n_nodes=len(ids),
+                    algorithm="louvain", n_p=4, max_rounds=2, seed=1)
+assert sub.get("cached"), f"restart did not serve from persisted cache: {sub}"
+res = client.result(sub["job_id"])
+assert res.get("partitions"), res
+m = client.metricsz()["fcobs"]["counters"]
+# the device was never touched: no compiles, no completed computations
+assert m.get("serve.xla_compiles", 0) == 0, m
+assert m.get("serve.jobs.completed", 0) == 0, m
+assert m.get("serve.cache.persist_loaded", 0) >= 1, m
+assert m.get("serve.jobs.cached", 0) >= 1, m
+print("fcserve cache-restart smoke ok: persisted hit served with "
+      "0 compiles, 0 device jobs")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcserve cache-restart smoke failed (exit $rc)" >&2
+    exit $rc
+fi
+kill -TERM "$SERVE_PID"
+wait "$SERVE_PID"
+SERVE_PID=""
+
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"
     exit 0

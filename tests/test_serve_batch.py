@@ -1,0 +1,470 @@
+"""Cross-request ensemble batching (ISSUE 5): coalescing pop, batch
+ladder, per-job bit-parity with solo execution, failure isolation,
+pre-warm, and result-cache persistence.
+
+Test ORDER in this file is deliberate: the ladder-compile pin runs
+before the parity tests so its cold counts are honest, and the later
+engine tests reuse the executables it compiled (same bucket statics +
+same n_p/tau/delta — max_rounds and seeds are traced and free)."""
+
+import os
+import threading
+from collections import deque
+
+import numpy as np
+import pytest
+
+
+def _ring_graph(n, chords=0, shift=7):
+    idx = np.arange(n)
+    edges = [np.stack([idx, (idx + 1) % n], 1)]
+    if chords:
+        c = np.arange(chords)
+        edges.append(np.stack([c % n, (c + shift) % n], 1))
+    return np.concatenate(edges).astype(np.int64)
+
+
+# Four distinct graphs that all land in the n64_e96 bucket (canonical
+# edge counts 68 / 78 / 66 / 72 — verified same class).
+def _bucket_graphs():
+    return [(_ring_graph(34, 40), 34),
+            (_ring_graph(40, 38, shift=5), 40),
+            (_ring_graph(33, 52, shift=13), 33),
+            (_ring_graph(36, 44, shift=11), 36)]
+
+
+def _spec(edges, n_nodes, priority=None, weights=None, **over):
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.jobs import PRIORITY_NORMAL, JobSpec
+
+    kwargs = dict(algorithm="louvain", n_p=4, tau=0.2, delta=0.02,
+                  max_rounds=2, seed=0)
+    kwargs.update(over)
+    return JobSpec(edges=np.asarray(edges, dtype=np.int64),
+                   n_nodes=n_nodes, config=ConsensusConfig(**kwargs),
+                   weights=weights,
+                   priority=PRIORITY_NORMAL if priority is None
+                   else priority)
+
+
+# -- ladder / grouping (pure host) ------------------------------------
+
+
+def test_batch_rung_ladder():
+    from fastconsensus_tpu.serve.bucketer import BATCH_LADDER, batch_rung
+
+    assert BATCH_LADDER == (1, 2, 4, 8)
+    assert [batch_rung(n) for n in (1, 2, 3, 4, 5, 6, 7, 8, 9, 100)] == \
+        [1, 2, 2, 4, 4, 4, 4, 8, 8, 8]
+    assert batch_rung(0) == 1
+
+
+def test_bucket_from_key_roundtrip_and_rejects():
+    from fastconsensus_tpu.serve.bucketer import (Bucket, bucket_for,
+                                                  bucket_from_key)
+
+    b = bucket_for(34, 78)
+    assert bucket_from_key(b.key()) == b
+    assert bucket_from_key("n64_e96") == Bucket(64, 96)
+    with pytest.raises(ValueError):
+        bucket_from_key("n64_e97")     # off-grid class
+    with pytest.raises(ValueError):
+        bucket_from_key("64x96")       # malformed
+
+
+def test_probe_edges_land_exactly_in_bucket():
+    from fastconsensus_tpu.serve.bucketer import (bucket_for,
+                                                  bucket_from_key,
+                                                  probe_edges)
+    from fastconsensus_tpu.serve.jobs import canonical_edges
+
+    for key in ("n64_e64", "n64_e96", "n128_e96", "n1024_e6144"):
+        bucket = bucket_from_key(key)
+        seen = set()
+        for variant in range(3):
+            edges = probe_edges(bucket, variant=variant)
+            u, v, _ = canonical_edges(edges, bucket.n_class, None)
+            assert int(u.shape[0]) == bucket.e_class, key
+            assert bucket_for(bucket.n_class, int(u.shape[0])) == bucket
+            content = tuple(map(tuple, np.stack([u, v], 1)))
+            assert content not in seen  # variants genuinely differ
+            seen.add(content)
+
+
+def test_batch_group_excludes_seed_only():
+    from fastconsensus_tpu.serve.jobs import Job
+
+    edges, n = _bucket_graphs()[0]
+    g1 = Job(_spec(edges, n, seed=1)).spec.batch_group()
+    g2 = Job(_spec(edges, n, seed=2)).spec.batch_group()
+    assert g1 == g2                    # seed is traced, coalesces
+    g3 = Job(_spec(edges, n, seed=1, n_p=8)).spec.batch_group()
+    assert g3 != g1                    # any other config field splits
+    big = _ring_graph(200, 100)
+    g4 = Job(_spec(big, 200, seed=1)).spec.batch_group()
+    assert g4 != g1                    # different bucket splits
+
+
+def test_pop_batch_coalesces_same_group_without_priority_starvation():
+    """The head pop stays strict (priority, seq); coalescing only pulls
+    same-group ride-alongs; different-group higher-priority work is
+    never skipped as a head."""
+    from fastconsensus_tpu.serve.jobs import (PRIORITY_BATCH,
+                                              PRIORITY_INTERACTIVE, Job)
+    from fastconsensus_tpu.serve.queue import AdmissionQueue
+
+    graphs = _bucket_graphs()
+    q = AdmissionQueue(max_depth=16)
+    group = [Job(_spec(e, n, seed=i, priority=PRIORITY_BATCH))
+             for i, (e, n) in enumerate(graphs)]
+    other = Job(_spec(_ring_graph(200, 100), 200, seed=9,
+                      priority=PRIORITY_INTERACTIVE))
+    for j in group[:2]:
+        q.submit(j)
+    q.submit(other)
+    for j in group[2:]:
+        q.submit(j)
+    gk = lambda job: job.spec.batch_group()  # noqa: E731
+    first = q.pop_batch(8, gk)
+    # the interactive job is the strict head; nothing shares its group
+    assert [j.job_id for j in first] == [other.job_id]
+    second = q.pop_batch(8, gk)
+    # the batch-priority group coalesces FIFO by admission order
+    assert [j.job_id for j in second] == [j.job_id for j in group]
+    # cap respected
+    for j in group:
+        q.submit(j)
+    capped = q.pop_batch(2, gk)
+    assert len(capped) == 2
+    assert q.depth() == 2
+    q.close()
+    while q.pop_batch(8, gk) is not None:
+        pass
+    assert q.pop_batch(8, gk) is None  # drain-complete signal
+
+
+def test_cache_spill_and_reload_roundtrip(tmp_path):
+    from fastconsensus_tpu.serve.cache import ResultCache
+
+    now = [100.0]
+    c = ResultCache(max_entries=8, ttl_seconds=50.0, clock=lambda: now[0])
+    fresh = {"content_hash": "aaa", "rounds": 3, "converged": True,
+             "cached": False,
+             "partitions": [np.arange(5, dtype=np.int32),
+                            np.ones(5, dtype=np.int32)]}
+    c.put("aaa", fresh)
+    now[0] = 130.0
+    c.put("bbb", dict(fresh, content_hash="bbb"))
+    c.put("skipme", "not-a-result-payload")  # non-standard: skipped
+    path = str(tmp_path / "cache.npz")
+    assert c.spill(path) == 2
+    # a restarted process: fresh cache, fresh (shifted) clock
+    now2 = [7.0]
+    c2 = ResultCache(max_entries=8, ttl_seconds=50.0,
+                     clock=lambda: now2[0])
+    assert c2.load(path) == 2
+    got = c2.get("aaa")
+    assert got["rounds"] == 3 and got["converged"] is True
+    assert np.array_equal(got["partitions"][0], fresh["partitions"][0])
+    # TTL persists as REMAINING lifetime: "aaa" was 30s old at spill,
+    # so it expires 20s into the new process's clock
+    now2[0] = 7.0 + 21.0
+    assert c2.get("aaa") is None
+    assert c2.get("bbb") is not None
+    # corrupt file loads nothing, does not raise
+    bad = str(tmp_path / "bad.npz")
+    with open(bad, "wb") as fh:
+        fh.write(b"garbage")
+    c3 = ResultCache(max_entries=8, ttl_seconds=50.0)
+    assert c3.load(bad) == 0
+
+
+# -- engine: ladder compile pin + bit-parity --------------------------
+
+
+def test_batch_ladder_compiles_once_per_rung(monkeypatch):
+    """ISSUE 5 acceptance: the {1, 2, 4} ladder rungs each compile on
+    first use and compile ZERO on warm replay with DIFFERENT same-bucket
+    graphs/seeds (rung 8 rides the same vmapped wrapper — covered by
+    the slow marker's B=8 path in bench.py serve_batch)."""
+    import jax
+
+    from fastconsensus_tpu.analysis import CompileGuard, \
+        assert_max_compiles
+    from fastconsensus_tpu.consensus import (ConsensusConfig,
+                                             run_consensus,
+                                             run_consensus_batch)
+    from fastconsensus_tpu.models.registry import get_detector
+    from fastconsensus_tpu.serve import bucketer
+
+    # the resident server's sizing posture: stable executables
+    monkeypatch.setenv("FCTPU_DETECT_CALL_MEMBERS", "0")
+    monkeypatch.setenv("FCTPU_ROUNDS_BLOCK", "8")
+    graphs = _bucket_graphs()
+    slabs, bucket = [], None
+    for e, n in graphs:
+        s, bucket = bucketer.pad_to_bucket(e, n)
+        slabs.append(s)
+    cfg = ConsensusConfig(algorithm="louvain", n_p=4, tau=0.2,
+                          delta=0.02, max_rounds=2, seed=0)
+    det = get_detector("louvain")
+    nc = bucket.n_closure
+    cold_counts = {}
+    for rung in (1, 2, 4):
+        with CompileGuard() as g:
+            if rung == 1:
+                run_consensus(slabs[0], det, cfg,
+                              key=jax.random.key(0), n_closure=nc)
+            else:
+                run_consensus_batch(slabs[:rung], det, cfg,
+                                    n_closure=nc,
+                                    seeds=list(range(rung)))
+        cold_counts[rung] = g.count
+        assert g.count > 0, f"rung {rung} compiled nothing cold?"
+    # warm replay: different graphs (rotated), different seeds -> 0
+    for rung in (1, 2, 4):
+        with assert_max_compiles(0):
+            if rung == 1:
+                run_consensus(slabs[1], det, cfg,
+                              key=jax.random.key(5), n_closure=nc)
+            else:
+                rot = slabs[1:] + slabs[:1]
+                run_consensus_batch(rot[:rung], det, cfg,
+                                    n_closure=nc,
+                                    seeds=[7 + i for i in range(rung)])
+
+
+def test_batch_bit_parity_with_solo_warm(monkeypatch):
+    """ISSUE 5 acceptance: every job in a coalesced batch produces
+    partitions identical to running it alone at the same seed — across
+    early convergence, batched stagnation refreshes, and the final
+    re-detection (the PRNG tree keys per job, never per batch)."""
+    import jax
+
+    from fastconsensus_tpu.consensus import (ConsensusConfig,
+                                             run_consensus,
+                                             run_consensus_batch)
+    from fastconsensus_tpu.models.registry import get_detector
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve import bucketer
+
+    monkeypatch.setenv("FCTPU_DETECT_CALL_MEMBERS", "0")
+    monkeypatch.setenv("FCTPU_ROUNDS_BLOCK", "8")
+    graphs = _bucket_graphs()
+    slabs, bucket = [], None
+    for e, n in graphs:
+        s, bucket = bucketer.pad_to_bucket(e, n)
+        slabs.append(s)
+    # max_rounds=10: the ring graphs' warm runs hit stagnation refreshes
+    # around rounds 7-8 and convergence at 8-9 (measured), so this
+    # window exercises refresh masking AND early-converged freezing
+    cfg = ConsensusConfig(algorithm="louvain", n_p=4, tau=0.2,
+                          delta=0.02, max_rounds=10, seed=0)
+    det = get_detector("louvain")
+    nc = bucket.n_closure
+    seeds = [11, 22, 33, 44]
+    solo = [run_consensus(s, det, cfg, key=jax.random.key(sd),
+                          n_closure=nc)
+            for s, sd in zip(slabs, seeds)]
+    base = obs_counters.get_registry().counters()
+    batch = run_consensus_batch(slabs, det, cfg, n_closure=nc,
+                                seeds=seeds)
+    since = obs_counters.get_registry().counters_since(base)
+    assert since.get("batch.solo_splits", 0) == 0, \
+        "nothing here should fall off the batched path"
+    rounds = [r.rounds for r in batch]
+    assert len(set(rounds)) > 1, \
+        f"want convergence at different rounds to exercise masking, " \
+        f"got {rounds}"
+    for i, (a, b) in enumerate(zip(solo, batch)):
+        assert a.rounds == b.rounds, (i, a.rounds, b.rounds)
+        assert a.converged == b.converged, i
+        assert a.history == b.history, i
+        for p, q in zip(a.partitions, b.partitions):
+            assert np.array_equal(p, q), f"job {i}: partition mismatch"
+
+
+def test_batch_bit_parity_with_solo_scratch():
+    """warm_start=False (the reference's only mode): the all-cold
+    scratch block must match solo round for round too."""
+    import jax
+
+    from fastconsensus_tpu.consensus import (ConsensusConfig,
+                                             run_consensus,
+                                             run_consensus_batch)
+    from fastconsensus_tpu.models.registry import get_detector
+    from fastconsensus_tpu.serve import bucketer
+
+    graphs = _bucket_graphs()[:2]
+    slabs, bucket = [], None
+    for e, n in graphs:
+        s, bucket = bucketer.pad_to_bucket(e, n)
+        slabs.append(s)
+    cfg = ConsensusConfig(algorithm="louvain", n_p=4, tau=0.2,
+                          delta=0.02, max_rounds=3, seed=0,
+                          warm_start=False)
+    det = get_detector("louvain")
+    nc = bucket.n_closure
+    seeds = [5, 6]
+    solo = [run_consensus(s, det, cfg, key=jax.random.key(sd),
+                          n_closure=nc)
+            for s, sd in zip(slabs, seeds)]
+    batch = run_consensus_batch(slabs, det, cfg, n_closure=nc,
+                                seeds=seeds)
+    for i, (a, b) in enumerate(zip(solo, batch)):
+        assert a.history == b.history, i
+        for p, q in zip(a.partitions, b.partitions):
+            assert np.array_equal(p, q), f"job {i}: partition mismatch"
+
+
+# -- serving layer: isolation, metadata, pre-warm ---------------------
+
+
+@pytest.fixture
+def service():
+    from fastconsensus_tpu.serve.server import ConsensusService, \
+        ServeConfig
+
+    return ConsensusService(ServeConfig(queue_depth=8, pin_sizing=False,
+                                        max_batch=4))
+
+
+def test_batch_failure_isolation_and_metadata(service):
+    """One NaN-weight graph in a coalesced group of 4 -> exactly 1
+    failed job, 3 completed (2 batched at rung 2 + 1 solo), with
+    batch_id/batch_size surfaced on /status and the serve.batch.*
+    counters moving."""
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.jobs import Job
+
+    graphs = _bucket_graphs()
+    w_nan = np.ones(graphs[1][0].shape[0], dtype=np.float32)
+    w_nan[3] = np.nan
+    jobs = [Job(_spec(graphs[0][0], graphs[0][1], seed=1)),
+            Job(_spec(graphs[1][0], graphs[1][1], seed=2,
+                      weights=w_nan)),
+            Job(_spec(graphs[2][0], graphs[2][1], seed=3)),
+            Job(_spec(graphs[3][0], graphs[3][1], seed=4))]
+    base = obs_counters.get_registry().counters()
+    service._run_batch(jobs)
+    since = obs_counters.get_registry().counters_since(base)
+    states = [j.state for j in jobs]
+    assert states[1] == "failed" and "non-finite" in jobs[1].error
+    assert [s for i, s in enumerate(states) if i != 1] == ["done"] * 3
+    # 3 survivors -> rung 2 batched + 1 solo (the ladder pin holds
+    # through pack failures)
+    sizes = sorted(j.batch_size for i, j in enumerate(jobs) if i != 1)
+    assert sizes == [1, 2, 2], sizes
+    coalesced = [j for j in jobs if j.batch_size == 2]
+    assert coalesced[0].batch_id == coalesced[1].batch_id
+    for j in coalesced:
+        d = j.describe()
+        assert d["batch_id"] == j.batch_id and d["batch_size"] == 2
+        assert j.result["batch_id"] == j.batch_id
+        assert j.result["batch_size"] == 2
+    assert since.get("serve.batch.coalesced", 0) == 1
+    assert since.get("serve.batch.occupancy", 0) == 2
+    assert since.get("serve.jobs.failed", 0) == 1
+    assert since.get("serve.jobs.completed", 0) == 3
+
+
+def test_batched_results_match_solo_service_results(service):
+    """Service-level parity: the batched worker path returns the same
+    partitions the solo run_spec path returns for the same specs."""
+    from fastconsensus_tpu.serve.jobs import Job
+
+    graphs = _bucket_graphs()[:2]
+    specs = [_spec(e, n, seed=50 + i)
+             for i, (e, n) in enumerate(graphs)]
+    solo = [service.run_spec(s) for s in specs]
+    service.cache._entries.clear()  # force real re-execution
+    jobs = [Job(s) for s in specs]
+    service._run_batch(jobs)
+    for job, ref in zip(jobs, solo):
+        assert job.state == "done", job.error
+        assert len(job.result["partitions"]) == len(ref["partitions"])
+        for p, q in zip(job.result["partitions"], ref["partitions"]):
+            assert np.array_equal(p, q)
+
+
+def test_worker_coalesces_queued_burst():
+    """End-to-end: jobs queued before the worker starts pop as ONE
+    coalesced batch; results land per job and the queue counter moves."""
+    import time
+
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.server import ConsensusService, \
+        ServeConfig
+
+    svc = ConsensusService(ServeConfig(queue_depth=8, pin_sizing=False,
+                                       max_batch=4))
+    graphs = _bucket_graphs()
+    base = obs_counters.get_registry().counters()
+    jobs = [svc.submit(_spec(e, n, seed=80 + i))
+            for i, (e, n) in enumerate(graphs)]
+    svc.start()
+    try:
+        deadline = time.monotonic() + 180
+        while any(j.state not in ("done", "failed") for j in jobs):
+            assert time.monotonic() < deadline, \
+                [j.describe() for j in jobs]
+            time.sleep(0.02)
+        assert all(j.state == "done" for j in jobs), \
+            [j.error for j in jobs]
+        assert all(j.batch_size == 4 for j in jobs)
+        since = obs_counters.get_registry().counters_since(base)
+        assert since.get("serve.queue.coalesced_pops", 0) >= 1
+        assert since.get("serve.batch.coalesced", 0) >= 1
+        assert since.get("serve.batch.occupancy", 0) >= 4
+    finally:
+        assert svc.drain(30)
+
+
+def test_prewarm_then_zero_compiles(monkeypatch):
+    """--warm contract: after pre-warming a bucket's ladder, a request
+    landing in it (solo or coalesced) compiles NOTHING."""
+    from fastconsensus_tpu.analysis import assert_max_compiles
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.jobs import Job
+    from fastconsensus_tpu.serve.server import ConsensusService, \
+        ServeConfig
+
+    monkeypatch.setenv("FCTPU_DETECT_CALL_MEMBERS", "0")
+    monkeypatch.setenv("FCTPU_ROUNDS_BLOCK", "8")
+    # n_p=5: executables distinct from every other test in this module,
+    # so the pre-warm is genuinely the first compile of these shapes
+    svc = ConsensusService(ServeConfig(
+        pin_sizing=False, max_batch=4, prewarm=("n64_e96:2",),
+        prewarm_config={"n_p": 5, "max_rounds": 2}))
+    base = obs_counters.get_registry().counters()
+    svc._prewarm_all()
+    since = obs_counters.get_registry().counters_since(base)
+    assert since.get("serve.prewarm.compiles", 0) > 0
+    assert since.get("serve.prewarm.buckets", 0) == 1
+    assert svc._prewarm_finished
+    graphs = _bucket_graphs()
+    with assert_max_compiles(0):
+        r = svc.run_spec(_spec(graphs[0][0], graphs[0][1], n_p=5))
+    assert r["bucket"]["key"] == "n64_e96"
+    jobs = [Job(_spec(e, n, seed=60 + i, n_p=5))
+            for i, (e, n) in enumerate(graphs[:2])]
+    with assert_max_compiles(0):
+        svc._run_batch(jobs)
+    assert all(j.state == "done" for j in jobs)
+
+
+def test_worker_drain_group_answers_cache_hits(service):
+    """A coalesced pop whose members were answered meanwhile must fan
+    the cache hits out without a device call for them."""
+    from fastconsensus_tpu.serve.jobs import Job
+
+    edges, n = _bucket_graphs()[0]
+    spec = _spec(edges, n, seed=99)
+    ref = service.run_spec(spec)           # fills the cache
+    j1, j2 = Job(spec), Job(_spec(edges, n, seed=98))
+    service._drain_group(deque([j1, j2]))
+    assert j1.state == "done" and j1.result["cached"]
+    assert np.array_equal(j1.result["partitions"][0],
+                          ref["partitions"][0])
+    assert j2.state == "done" and not j2.result["cached"]
+    assert j2.batch_size == 1              # solo remainder
