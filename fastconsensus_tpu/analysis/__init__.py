@@ -26,12 +26,37 @@ from fastconsensus_tpu.analysis.recompile_guard import (  # noqa: F401
     CompileGuard, RecompileError, assert_max_compiles)
 
 
-def lint_paths(paths, report=None):
-    """Lint every ``.py`` under ``paths`` (files or directories) into a
-    Report (created if not given)."""
+def _module_name(path):
+    """Dotted module name of a scanned file, for the cross-module
+    key-reuse summary table: everything from the ``fastconsensus_tpu``
+    package root down when the file lives inside it, the bare stem
+    otherwise (fixtures and scripts import each other by stem, if at
+    all)."""
     import os
 
-    from fastconsensus_tpu.analysis.astlint import lint_source
+    parts = os.path.normpath(os.path.abspath(path)).split(os.sep)
+    name = parts[-1][:-3] if parts[-1].endswith(".py") else parts[-1]
+    if "fastconsensus_tpu" in parts[:-1]:
+        i = parts.index("fastconsensus_tpu")
+        mods = parts[i:-1] + ([] if name == "__init__" else [name])
+        return ".".join(mods)
+    return name
+
+
+def lint_paths(paths, report=None):
+    """Lint every ``.py`` under ``paths`` (files or directories) into a
+    Report (created if not given).
+
+    Two passes: the first summarizes every function's PRNG-key
+    consumption (astlint.summarize_key_params), the second lints with
+    that table in hand — so the ``key-reuse`` rule tracks keys through
+    helper calls across module boundaries (e.g. ``seg.pair_jitter``)
+    instead of treating every callee as an opaque single draw.
+    """
+    import os
+
+    from fastconsensus_tpu.analysis.astlint import (lint_source,
+                                                    summarize_key_params)
 
     if report is None:
         report = Report()
@@ -45,10 +70,21 @@ def lint_paths(paths, report=None):
                              if f.endswith(".py"))
         elif p.endswith(".py"):
             files.append(p)
+    sources = {}
     for f in files:
         with open(f, encoding="utf-8") as fh:
-            src = fh.read()
-        diags, suppressed = lint_source(src, filename=f)
+            sources[f] = fh.read()
+    summaries = {}
+    for f, src in sources.items():
+        mod = _module_name(f)
+        table = summarize_key_params(src, filename=f)
+        if table:
+            # first writer wins on a (pathological) duplicate module
+            # name; identical files produce identical tables anyway
+            summaries.setdefault(mod, table)
+    for f, src in sources.items():
+        diags, suppressed = lint_source(src, filename=f,
+                                        key_summaries=summaries)
         report.extend(diags)
         report.n_suppressed += suppressed
         report.n_files += 1

@@ -11,6 +11,19 @@ changes *performance* or *distributions*, not output shapes:
     keys are not stateful; reuse silently correlates draws
     (utils/prng.py's single-tree contract).
 
+    The rule is **cross-function**: passing a key to a helper counts by
+    what the helper actually does with it.  A per-function summary
+    (:func:`summarize_key_params`) classifies every key-ish parameter as
+    a pure *deriver* (weight 0 — only split/fold_in-style derivations:
+    safe to call repeatedly, e.g. a local ``fan_out(key, n)`` wrapper),
+    a single *draw* (weight 1 — e.g. ``seg.pair_jitter``, which salts
+    one ``random.bits`` from its key), or an internal *re-user* (weight
+    2).  Summaries resolve through module-local defs and import aliases
+    (``from ..ops import segment as seg`` -> ``seg.pair_jitter``);
+    ``lint_paths`` builds the table over the whole scanned file set
+    first, so the weights cross module boundaries.  Unknown callees keep
+    the conservative weight of 1.
+
 ``traced-branch``
     Python ``if``/``while`` tests (or ``bool()`` casts) built from
     ``jnp.*`` calls.  Inside jit this is a tracer leak
@@ -74,6 +87,7 @@ All rules support ``# fcheck: ok=<rule>`` suppression pragmas
 from __future__ import annotations
 
 import ast
+import os
 from typing import Dict, List, Optional, Set, Tuple
 
 from fastconsensus_tpu.analysis.diagnostics import (Diagnostic,
@@ -220,12 +234,35 @@ class _KeyState:
             self.depth.update(o.depth)
 
 
+def _key_param_names(fn: ast.FunctionDef) -> List[str]:
+    """The parameters of ``fn`` the key-reuse rule tracks as PRNG keys
+    (name-based, same heuristic as the intra-function seeding)."""
+    out = []
+    for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs):
+        n = a.arg
+        if n == "key" or n == "rng" or n.endswith("_key") or \
+                n == "keys" or n.endswith("_keys"):
+            out.append(n)
+    return out
+
+
 class Linter:
-    def __init__(self, source: str, filename: str = "<memory>") -> None:
+    def __init__(self, source: str, filename: str = "<memory>",
+                 key_summaries: Optional[Dict[str, Dict[str, dict]]] = None
+                 ) -> None:
         self.source = source
         self.filename = filename
         self.diags: List[Diagnostic] = []
         self.n_suppressed = 0
+        # cross-function key flow (module docstring, `key-reuse`):
+        # {module: {function: summary}} built by lint_paths over the
+        # whole scanned set; local defs and import aliases resolve into
+        # it at call sites.
+        self._key_summaries = key_summaries or {}
+        self._local_summaries: Dict[str, dict] = {}
+        self._alias_modules: Dict[str, str] = {}
+        self._from_imports: Dict[str, Tuple[str, str]] = {}
+        self._summary_peaks: Optional[Dict[str, int]] = None
 
     def run(self) -> List[Diagnostic]:
         try:
@@ -235,6 +272,8 @@ class Linter:
                 rule="syntax-error", message=str(e.msg),
                 file=self.filename, line=e.lineno or 0, col=e.offset or 0))
             return self.diags
+        self._collect_imports(tree)
+        self._summarize_tree(tree)
         self._module_level(tree)
         self._check_mesh_axes(tree)
         for node in ast.walk(tree):
@@ -245,6 +284,108 @@ class Linter:
         self.diags, self.n_suppressed = apply_pragmas(self.diags,
                                                       self.source)
         return self.diags
+
+    # ---------------- cross-function key summaries ----------------
+
+    def _package_parts(self) -> List[str]:
+        """Dotted-path components of the package containing this file's
+        module (the relative-import anchor): everything from the
+        ``fastconsensus_tpu`` root down to the directory, which is the
+        level-1 base for regular modules and ``__init__`` alike.  Empty
+        outside the tree — bare-stem modules (fixtures, scripts) cannot
+        anchor relative imports."""
+        parts = os.path.normpath(
+            os.path.abspath(self.filename)).split(os.sep)
+        if "fastconsensus_tpu" not in parts[:-1]:
+            return []
+        return parts[parts.index("fastconsensus_tpu"):-1]
+
+    def _collect_imports(self, tree: ast.Module) -> None:
+        """Alias -> module map for resolving helper calls into the
+        cross-module summary table (``import a.b.c as x`` and
+        ``from a.b import c [as x]`` both bind x to a module; ``from
+        a.b.c import fn`` binds a function — tracked separately).
+        Relative imports (``from ..ops import segment as seg``) resolve
+        against this file's own package path; outside the package tree
+        they stay unresolved (conservative weight 1)."""
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    if a.asname:
+                        self._alias_modules[a.asname] = a.name
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.level == 0:
+                    module = stmt.module
+                else:
+                    pkg = self._package_parts()
+                    if not pkg or stmt.level - 1 >= len(pkg):
+                        continue
+                    base = pkg[: len(pkg) - (stmt.level - 1)]
+                    module = ".".join(
+                        base + ([stmt.module] if stmt.module else []))
+                if not module:
+                    continue
+                for a in stmt.names:
+                    alias = a.asname or a.name
+                    # could name a submodule OR a function; record both
+                    # interpretations and let lookup pick whichever the
+                    # summary table actually contains
+                    self._alias_modules.setdefault(
+                        alias, f"{module}.{a.name}")
+                    self._from_imports[alias] = (module, a.name)
+
+    def _summarize_tree(self, tree: ast.Module) -> Dict[str, dict]:
+        """Key-consumption summaries of this module's top-level
+        functions: for each key-ish parameter, the max number of
+        consumptions one call incurs (0 = pure deriver, 1 = one draw,
+        2 = internal reuse), computed with the same path-sensitive walk
+        the lint itself uses.  Methods are skipped (call-site positional
+        mapping would be off by the bound ``self``).  Summaries land in
+        ``self._local_summaries`` AS they are built, so a later function
+        calling an earlier helper resolves it (definition order covers
+        the helper-before-caller layout this codebase uses)."""
+        out = self._local_summaries
+        for node in tree.body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            args = node.args
+            pos = [a.arg for a in (args.posonlyargs + args.args)]
+            if pos and pos[0] in ("self", "cls"):
+                continue
+            key_params = _key_param_names(node)
+            if not key_params:
+                continue
+            state = _KeyState()
+            for n in key_params:
+                state.fresh(n)
+            self._summary_peaks = {}
+            self._walk_keys(list(node.body), state, loop_depth=0,
+                            skip_defs=True)
+            peaks = self._summary_peaks
+            self._summary_peaks = None
+            out[node.name] = {
+                "name": node.name,
+                "params": pos,
+                "weights": {p: min(peaks.get(p, 0), 2)
+                            for p in key_params},
+            }
+        return out
+
+    def _lookup_summary(self, qual: Optional[str],
+                        name: str) -> Optional[dict]:
+        """The callee's key summary, resolved through local defs, import
+        aliases, or a fully-dotted qualifier; None = unknown callee."""
+        if qual is None:
+            local = self._local_summaries.get(name)
+            if local is not None:
+                return local
+            tgt = self._from_imports.get(name)
+            if tgt is not None:
+                return self._key_summaries.get(tgt[0], {}).get(tgt[1])
+            return None
+        mod = self._alias_modules.get(qual, qual)
+        return self._key_summaries.get(mod, {}).get(name)
 
     def _diag(self, rule: str, node: ast.AST, message: str) -> None:
         self.diags.append(Diagnostic(
@@ -413,30 +554,35 @@ class Linter:
 
     def _check_key_reuse(self, fn: ast.FunctionDef) -> None:
         state = _KeyState()
-        args = fn.args
-        for a in (args.posonlyargs + args.args + args.kwonlyargs):
-            n = a.arg
-            if n == "key" or n == "rng" or n.endswith("_key") or \
-                    n == "keys" or n.endswith("_keys"):
-                state.fresh(n)
+        for n in _key_param_names(fn):
+            state.fresh(n)
         self._walk_keys(list(fn.body), state, loop_depth=0,
                         skip_defs=True)
 
     def _consume(self, state: _KeyState, name: str, node: ast.AST,
-                 weight: int) -> None:
+                 weight: int, via: Optional[str] = None) -> None:
         canon = state.canon(name)
-        if canon is None:
+        if canon is None or weight <= 0:
             return
         state.count[canon] = state.count.get(canon, 0) + weight
         if canon not in state.site:
             state.site[canon] = (getattr(node, "lineno", 0),
                                  getattr(node, "col_offset", 0))
+        if self._summary_peaks is not None:
+            # summary mode: record the peak, emit nothing (the callers
+            # of this function get the weight; its own body gets its
+            # own normal lint pass)
+            self._summary_peaks[canon] = max(
+                self._summary_peaks.get(canon, 0), state.count[canon])
+            return
         if state.count[canon] >= 2:
+            hint = f" (helper {via!r} draws from its key argument)" \
+                if via else ""
             self._diag(
                 "key-reuse", node,
                 f"PRNG key {name!r} consumed more than once on one "
                 "execution path; split/fold_in a fresh subkey per "
-                "consumer (utils/prng.py)")
+                f"consumer (utils/prng.py){hint}")
             # report once per key
             state.drop(name)
             state.count.pop(canon, None)
@@ -464,7 +610,62 @@ class Linter:
                             if isinstance(el, ast.Name):
                                 state.fresh(el.id, loop_depth)
                 return True
+            if self._is_deriver_helper(qual, name, value, state):
+                # a derive-only HELPER consumes nothing either, but its
+                # return value is whatever the helper returns — not
+                # necessarily keys — so targets merely stop being
+                # tracked (unlike the jax derivers above)
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        state.drop(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for el in t.elts:
+                            if isinstance(el, ast.Name):
+                                state.drop(el.id)
+                return True
         return False
+
+    def _is_deriver_helper(self, qual: Optional[str], name: str,
+                           call: ast.Call, state: _KeyState) -> bool:
+        """A helper whose summary says every tracked key argument maps
+        to a weight-0 (derive-only) parameter — e.g. a local
+        ``fan_out(key, n)`` wrapper around ``random.split``.  Such
+        helpers may be called repeatedly on one key, exactly like the
+        jax derivers themselves."""
+        summary = self._lookup_summary(qual, name)
+        if summary is None:
+            return False
+        saw_key = False
+        for pos, arg in enumerate(call.args):
+            if isinstance(arg, ast.Name) and state.canon(arg.id):
+                saw_key = True
+                if self._arg_weight(summary, pos=pos, kw=None) != 0:
+                    return False
+        for kw in call.keywords:
+            if isinstance(kw.value, ast.Name) and \
+                    state.canon(kw.value.id):
+                saw_key = True
+                if self._arg_weight(summary, pos=None,
+                                    kw=kw.arg) != 0:
+                    return False
+        return saw_key
+
+    @staticmethod
+    def _arg_weight(summary: Optional[dict], pos: Optional[int],
+                    kw: Optional[str]) -> int:
+        """How many consumptions passing a key as this argument costs,
+        per the callee's summary; 1 (the conservative default) when the
+        callee or the receiving parameter is unknown."""
+        if summary is None:
+            return 1
+        pname = kw
+        if pname is None and pos is not None and \
+                pos < len(summary["params"]):
+            pname = summary["params"][pos]
+        if pname is None:
+            return 1
+        w = summary["weights"].get(pname)
+        return 1 if w is None else w
 
     def _walk_keys(self, stmts: List[ast.stmt], state: _KeyState,
                    loop_depth: int, skip_defs: bool = False) -> bool:
@@ -547,11 +748,14 @@ class Linter:
                         loop_depth: int) -> None:
         """Count key consumptions inside an expression.
 
-        A bare key name passed as an argument to a call counts as one
-        consumption — unless the callee is a pure key *deriver*
-        (split/fold_in/...), which may be called repeatedly.  Inside a
-        Python loop a consumption of a key derived *outside* the loop
-        counts double (it repeats every iteration).
+        A bare key name passed as an argument to a call counts by what
+        the callee does with it: nothing for pure derivers
+        (split/fold_in/... and weight-0 summarized helpers), the
+        callee's summarized consumption count for known helpers
+        (cross-function pass — module docstring), and the conservative
+        1 for unknown callees.  Inside a Python loop a consumption of a
+        key derived *outside* the loop counts double (it repeats every
+        iteration).
         """
         if expr is None:
             return
@@ -560,14 +764,23 @@ class Linter:
                 continue
             qual, name = _call_name(node)
             derives = _is_key_deriver(qual, name)
-            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            summary = None if derives else self._lookup_summary(qual,
+                                                                name)
+            via = summary["name"] if summary else None
+            args = [(pos, None, a) for pos, a in enumerate(node.args)] \
+                + [(None, kw.arg, kw.value) for kw in node.keywords]
+            for pos, kwname, arg in args:
                 if isinstance(arg, ast.Name) and state.canon(arg.id):
                     if derives:
                         continue
+                    weight = self._arg_weight(summary, pos=pos,
+                                              kw=kwname)
+                    if weight <= 0:
+                        continue
                     canon = state.canon(arg.id)
-                    weight = 2 if loop_depth > state.depth.get(canon, 0) \
-                        else 1
-                    self._consume(state, arg.id, node, weight)
+                    if loop_depth > state.depth.get(canon, 0):
+                        weight = max(weight, 2)
+                    self._consume(state, arg.id, node, weight, via=via)
 
     # -- traced-branch ----------------------------------------------
 
@@ -837,9 +1050,28 @@ def _free_names(fn: ast.FunctionDef) -> Set[str]:
     return free
 
 
-def lint_source(source: str, filename: str = "<memory>"
-                ) -> Tuple[List[Diagnostic], int]:
-    """Lint one source string; returns (diagnostics, n_suppressed)."""
+def summarize_key_params(source: str, filename: str = "<memory>"
+                         ) -> Dict[str, dict]:
+    """Per-function key-consumption summaries of one module (the
+    cross-function ``key-reuse`` table; see Linter._summarize_tree).
+    Unparseable sources summarize to nothing — the lint pass will
+    report the syntax error itself."""
+    try:
+        tree = ast.parse(source, filename=filename)
+    except SyntaxError:
+        return {}
     linter = Linter(source, filename)
+    linter._collect_imports(tree)
+    return linter._summarize_tree(tree)
+
+
+def lint_source(source: str, filename: str = "<memory>",
+                key_summaries: Optional[Dict[str, Dict[str, dict]]] = None
+                ) -> Tuple[List[Diagnostic], int]:
+    """Lint one source string; returns (diagnostics, n_suppressed).
+    ``key_summaries`` ({module: {function: summary}}) enables the
+    cross-module half of the key-reuse rule (lint_paths builds it over
+    the whole scanned set; module-local helpers resolve either way)."""
+    linter = Linter(source, filename, key_summaries=key_summaries)
     diags = linter.run()
     return diags, linter.n_suppressed

@@ -47,6 +47,13 @@ class CompileGuard:
     .ObsRegistry``), so a traced run's compile count lands in the same
     artifact as its spans and host-sync counts (``bench.py`` telemetry).
     :meth:`attach` sets the same hook after construction.
+
+    ``thread_ident`` restricts counting to compiles observed on ONE
+    thread (``threading.get_ident()``): jax's monitoring events fire on
+    the thread driving the compile, so a multi-worker process (the
+    fcpool device workers, serve/pool.py) can attribute compiles
+    per-worker with concurrent guards — an unfiltered guard in that
+    process would charge worker A for executables worker B built.
     """
 
     _COMPILE_EVENTS = (
@@ -54,7 +61,8 @@ class CompileGuard:
     )
 
     def __init__(self, max_compiles: Optional[int] = None,
-                 registry=None, counter: str = "xla.compiles") -> None:
+                 registry=None, counter: str = "xla.compiles",
+                 thread_ident: Optional[int] = None) -> None:
         self.max_compiles = max_compiles
         self.count = 0
         self.events: List[str] = []
@@ -63,6 +71,7 @@ class CompileGuard:
         self._active = False
         self._registry = registry
         self._counter = counter
+        self._thread_ident = thread_ident
 
     def attach(self, registry, counter: str = "xla.compiles"
                ) -> "CompileGuard":
@@ -79,6 +88,9 @@ class CompileGuard:
         # unregistered (see _unregister): jax holds the bound method, so
         # only a flag on the instance can make it inert
         if not self._active or name not in self._COMPILE_EVENTS:
+            return
+        if self._thread_ident is not None and \
+                threading.get_ident() != self._thread_ident:
             return
         with self._lock:
             self.count += 1

@@ -67,11 +67,15 @@ def write_jsonl(path: str, events: List[dict],
 
 def to_perfetto(events: List[dict],
                 snapshot: Optional[dict] = None,
-                process_name: str = PROCESS_NAME) -> dict:
+                process_name: str = PROCESS_NAME,
+                thread_names: Optional[Dict[int, str]] = None) -> dict:
     """Chrome ``trace_event`` object form of a span list (see module
     docstring).  Host thread idents map to small stable tids (in order of
     first appearance) with ``thread_name`` metadata, so multi-threaded
-    traces render as named tracks."""
+    traces render as named tracks.  ``thread_names`` overrides the
+    generic names per raw thread ident — the multi-device server passes
+    its worker map so each device renders as its own named track
+    ("device-0", "mesh-6", ...; serve/pool.py thread_names)."""
     tids: Dict[int, int] = {}
     for ev in events:
         tids.setdefault(ev.get("tid", 0), len(tids) + 1)
@@ -80,7 +84,8 @@ def to_perfetto(events: List[dict],
         "args": {"name": process_name},
     }]
     for ident, tid in tids.items():
-        name = "driver" if tid == 1 else f"thread-{tid}"
+        name = (thread_names or {}).get(ident) or (
+            "driver" if tid == 1 else f"thread-{tid}")
         trace_events.append({
             "name": "thread_name", "ph": "M", "pid": _PID, "tid": tid,
             "ts": 0, "args": {"name": name},
@@ -131,8 +136,10 @@ def write_perfetto_blob(path: str, blob: dict) -> None:
 
 def write_perfetto(path: str, events: List[dict],
                    snapshot: Optional[dict] = None,
-                   process_name: str = PROCESS_NAME) -> None:
-    write_perfetto_blob(path, to_perfetto(events, snapshot, process_name))
+                   process_name: str = PROCESS_NAME,
+                   thread_names: Optional[Dict[int, str]] = None) -> None:
+    write_perfetto_blob(path, to_perfetto(events, snapshot, process_name,
+                                          thread_names=thread_names))
 
 
 def _rotated_entries(path: str) -> List[tuple]:

@@ -107,6 +107,10 @@ def _normalize(rec: dict, source: str, seq: Optional[int]) -> dict:
                              else None),
         "round_p95_s": p95("round_s"),
         "detect_p95_s": p95("detect_call_s"),
+        # multi-device serving artifacts (bench.py serve_multichip /
+        # serve/pool.py): per-device jobs/compiles/busy breakdown, kept
+        # verbatim for device_table()
+        "devices": tel.get("devices") or None,
     }
 
 
@@ -210,6 +214,49 @@ def trend_table(groups: Dict[str, List[dict]],
                                        for c, w in zip(row, widths)))
         lines.append("")
     return "\n".join(lines).rstrip() or "(no bench records found)"
+
+
+def device_table(groups: Dict[str, List[dict]],
+                 markdown: bool = False) -> str:
+    """Per-device breakdown tables for configs whose newest record
+    carries one (the ``serve_multichip`` artifacts): device, tier kind,
+    jobs, batches, XLA compiles, busy seconds and busy fraction.  Empty
+    string when no record in the history has device telemetry."""
+    header = ["device", "kind", "jobs", "batches", "compiles",
+              "busy_s", "busy_frac", "cordoned"]
+    lines: List[str] = []
+    for config, recs in groups.items():
+        newest = next((r for r in reversed(recs) if r.get("devices")),
+                      None)
+        if newest is None:
+            continue
+        rows = []
+        for dev in sorted(newest["devices"], key=lambda d: int(d)):
+            d = newest["devices"][dev]
+            rows.append([dev, str(d.get("kind", "-")),
+                         _fmt(d.get("jobs"), 0),
+                         _fmt(d.get("batches"), 0),
+                         _fmt(d.get("xla_compiles"), 0),
+                         _fmt(d.get("busy_s")),
+                         _fmt(d.get("busy_frac")),
+                         "yes" if d.get("cordoned") else "no"])
+        title = f"{config} devices [{newest['source']}]"
+        if markdown:
+            lines.append(f"### {title}")
+            lines.append("| " + " | ".join(header) + " |")
+            lines.append("|" + "|".join("---" for _ in header) + "|")
+            lines.extend("| " + " | ".join(row) + " |" for row in rows)
+        else:
+            lines.append(f"== {title} ==")
+            widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+                      for i in range(len(header))]
+            lines.append("  ".join(h.ljust(w)
+                                   for h, w in zip(header, widths)))
+            for row in rows:
+                lines.append("  ".join(c.ljust(w)
+                                       for c, w in zip(row, widths)))
+        lines.append("")
+    return "\n".join(lines).rstrip()
 
 
 def check_history(groups: Dict[str, List[dict]],

@@ -243,6 +243,18 @@ class Tracer:
         with self._lock:
             self._events.clear()
 
+    def drain_since(self, start: int) -> List[dict]:
+        """Atomically snapshot finished spans from ``start`` on AND
+        clear the buffer — the window-reset primitive for multi-worker
+        streamers (serve/server.py): a separate ``events_since`` +
+        ``clear`` pair can lose a span another thread closes between
+        the two calls (wiped from memory without ever being
+        streamed)."""
+        with self._lock:
+            tail = list(self._events[start:])
+            self._events.clear()
+            return tail
+
 
 # The ambient tracer consulted by instrumented code.  Disabled by default:
 # run_consensus and the engine call get_tracer() unconditionally, and the

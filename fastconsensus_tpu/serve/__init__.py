@@ -43,6 +43,8 @@ _EXPORTS = {
     "QueueFull": "queue",
     "ConsensusService": "server", "GraphTooLarge": "server",
     "ServeConfig": "server", "make_http_server": "server",
+    "DeviceWorker": "pool", "MeshWorker": "pool", "WorkerPool": "pool",
+    "NoEligibleWorker": "scheduler", "StickyScheduler": "scheduler",
 }
 
 __all__ = sorted(_EXPORTS)

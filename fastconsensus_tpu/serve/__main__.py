@@ -70,6 +70,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "loaded at startup, spilled (npz) on graceful "
                         "drain — a restarted server answers repeats of "
                         "pre-restart work without touching the device")
+    p.add_argument("--devices", type=int, default=None, metavar="N",
+                   help="drive N local devices with one worker each "
+                        "(default: all of them; 1 = single-worker). "
+                        "Same-bucket traffic sticks to the device that "
+                        "compiled the bucket (serve/scheduler.py)")
+    p.add_argument("--huge-devices", type=int, default=d.huge_devices,
+                   metavar="K",
+                   help="reserve the last K devices as a mesh group for "
+                        "the huge tier (graphs past --chip-max-edges run "
+                        "edge-sharded across it; default "
+                        f"{d.huge_devices} = tier off)")
+    p.add_argument("--chip-max-edges", type=int, default=d.chip_max_edges,
+                   metavar="E",
+                   help="single-chip bucket ceiling: buckets with edge "
+                        "class > E route to the huge tier (requires "
+                        "--huge-devices >= 1)")
+    p.add_argument("--spill-backlog", type=int, default=d.spill_backlog,
+                   metavar="J",
+                   help="sticky-affinity spill threshold: a bucket's "
+                        "work leaves its home device only when more "
+                        "than J jobs are queued there (default "
+                        f"{d.spill_backlog})")
     p.add_argument("--no-pin-sizing", action="store_true",
                    help="let the engine re-size executables adaptively "
                         "per request (default: pinned — stable bucket "
@@ -111,6 +133,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.max_batch < 1:
         print("error: --max-batch must be >= 1", file=sys.stderr)
         return 2
+    if args.chip_max_edges is not None and args.huge_devices < 1:
+        print("error: --chip-max-edges needs --huge-devices >= 1 (the "
+              "huge tier is what runs graphs past the ceiling)",
+              file=sys.stderr)
+        return 2
+    if args.huge_devices >= 1 and args.chip_max_edges is None:
+        print("error: --huge-devices without --chip-max-edges reserves "
+              "a mesh group no bucket can ever route to; set the "
+              "single-chip ceiling too", file=sys.stderr)
+        return 2
     cfg = ServeConfig(queue_depth=args.queue_depth,
                       cache_entries=args.cache_entries,
                       cache_ttl_s=args.cache_ttl,
@@ -122,8 +154,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                       max_batch=args.max_batch,
                       cache_path=args.cache_file,
                       prewarm=tuple(args.warm),
-                      prewarm_config=warm_config)
-    service = ConsensusService(cfg).start()
+                      prewarm_config=warm_config,
+                      devices=args.devices,
+                      huge_devices=args.huge_devices,
+                      chip_max_edges=args.chip_max_edges,
+                      spill_backlog=args.spill_backlog)
+    try:
+        service = ConsensusService(cfg).start()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    n_workers = len(service.pool.workers)
+    n_mesh = len(service.pool.mesh_workers)
+    say(f"worker pool: {n_workers - n_mesh} chip worker(s)"
+        + (f" + 1 mesh group of {len(service.pool.mesh_workers[0].devices)}"
+           f" device(s) (huge tier, bucket ceiling "
+           f"{cfg.chip_max_edges} edges)" if n_mesh else ""))
     if args.warm:
         say(f"pre-warming {len(args.warm)} bucket(s): "
             f"{', '.join(args.warm)}")

@@ -14,11 +14,51 @@ overloaded server answering instead of queueing itself to death
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import time
 import urllib.error
 import urllib.request
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerState:
+    """One pool worker's ``/healthz`` entry, typed (serve/pool.py).
+
+    ``device`` is the worker's device ordinal (the fcobs ``device=i``
+    tag); ``kind`` is ``"chip"`` or ``"mesh"`` (the huge tier);
+    ``cordoned`` workers died and take no more work; ``buckets`` is the
+    bucket residency (bucket key -> jobs served there) the sticky
+    scheduler routes on.
+    """
+
+    device: int
+    kind: str
+    alive: bool
+    cordoned: bool
+    backlog: int
+    jobs: int
+    batches: int
+    busy_s: float
+    buckets: Dict[str, int]
+    warm: Tuple[str, ...]
+    prewarm_pending: int
+    error: Optional[str] = None
+    mesh_devices: Tuple[int, ...] = ()
+
+    @classmethod
+    def from_payload(cls, w: Dict[str, Any]) -> "WorkerState":
+        return cls(device=int(w["device"]), kind=str(w["kind"]),
+                   alive=bool(w["alive"]), cordoned=bool(w["cordoned"]),
+                   backlog=int(w["backlog"]), jobs=int(w["jobs"]),
+                   batches=int(w["batches"]),
+                   busy_s=float(w["busy_s"]),
+                   buckets=dict(w.get("buckets") or {}),
+                   warm=tuple(w.get("warm") or ()),
+                   prewarm_pending=int(w.get("prewarm_pending", 0)),
+                   error=w.get("error"),
+                   mesh_devices=tuple(w.get("mesh_devices") or ()))
 
 
 class ServeError(RuntimeError):
@@ -106,6 +146,17 @@ class ServeClient:
 
     def metricsz(self) -> Dict[str, Any]:
         return self._request("/metricsz")
+
+    def workers(self) -> List[WorkerState]:
+        """The pool's per-worker state (``/healthz``), typed: device id,
+        tier kind, bucket residency, queue backlog, cordoned flag."""
+        return [WorkerState.from_payload(w)
+                for w in self.healthz().get("workers", ())]
+
+    def device_metrics(self) -> Dict[str, Dict[str, Any]]:
+        """Per-device breakdown from ``/metricsz`` (jobs, batches,
+        compiles, busy-fraction, cordon state), keyed by device id."""
+        return self.metricsz().get("devices", {})
 
     def coalescing(self) -> Dict[str, Any]:
         """Operator view of cross-request batching, extracted from

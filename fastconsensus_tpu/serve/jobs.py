@@ -148,6 +148,23 @@ class JobSpec:
         return hash_canonical(self.canonical(), self.n_nodes,
                               self.config)
 
+    def bucket(self):
+        """Memoized shape bucket (serve/bucketer.py) — the routing key
+        of the multi-device scheduler (serve/scheduler.py): the pool
+        dispatcher classifies every popped job by bucket, and a fresh
+        O(E log E) canonicalization under the dispatch path would stall
+        every worker behind one big graph.  ``canonical()`` is already
+        memoized; this adds the grid lookup on top."""
+        cached = getattr(self, "_bucket", None)
+        if cached is None:
+            from fastconsensus_tpu.serve import bucketer
+
+            u, _, _ = self.canonical()
+            cached = bucketer.bucket_for(self.n_nodes,
+                                         max(int(u.shape[0]), 1))
+            object.__setattr__(self, "_bucket", cached)
+        return cached
+
     def batch_group(self) -> str:
         """Coalescing key for cross-request batching (serve/queue.py
         ``pop_batch``): two jobs may share one batched device call iff
@@ -160,13 +177,8 @@ class JobSpec:
         """
         cached = getattr(self, "_batch_group", None)
         if cached is None:
-            from fastconsensus_tpu.serve import bucketer
-
-            u, _, _ = self.canonical()
-            bucket = bucketer.bucket_for(self.n_nodes,
-                                         max(int(u.shape[0]), 1))
             cfg = dataclasses.replace(self.config, seed=0)
-            cached = f"{bucket.key()}|" \
+            cached = f"{self.bucket().key()}|" \
                      f"{repr(dataclasses.astuple(cfg))}"
             object.__setattr__(self, "_batch_group", cached)
         return cached
@@ -195,12 +207,34 @@ class Job:
         # batch_size stays 1 for solo execution.
         self.batch_id: Optional[str] = None
         self.batch_size: int = 1
+        # Multi-device metadata (serve/pool.py): the worker/device tag
+        # that ran (or is running) the job, and the devices this job may
+        # no longer be routed to — a worker that dies mid-batch requeues
+        # its jobs with itself excluded, so a job that KILLS workers
+        # burns through the pool at most once per device and then fails
+        # as itself instead of looping forever.
+        self.device: Optional[int] = None
+        self.requeues: int = 0
+        self._excluded: frozenset = frozenset()
         self._lock = threading.Lock()
 
     def set_batch(self, batch_id: str, batch_size: int) -> None:
         with self._lock:
             self.batch_id = batch_id
             self.batch_size = int(batch_size)
+
+    def set_device(self, device: int) -> None:
+        with self._lock:
+            self.device = int(device)
+
+    def exclude_device(self, device: int) -> None:
+        with self._lock:
+            self._excluded = self._excluded | {int(device)}
+            self.requeues += 1
+
+    def excluded(self) -> frozenset:
+        with self._lock:
+            return self._excluded
 
     def mark(self, state: str, result: Optional[Dict[str, Any]] = None,
              error: Optional[str] = None) -> None:
@@ -233,4 +267,7 @@ class Job:
                 "error": self.error,
                 "batch_id": self.batch_id,
                 "batch_size": self.batch_size,
+                "device": self.device,
+                "requeues": self.requeues,
+                "excluded_devices": sorted(self._excluded),
             }

@@ -1,0 +1,540 @@
+"""fcpool: the multi-device worker pool behind ``ConsensusService``.
+
+Until now the service drove ONE device from one worker thread while the
+environment reported 8 green chips (MULTICHIP_r05.json) — 7/8 of the
+machine idle by construction.  The pool puts every chip to work without
+giving up the serving contracts:
+
+* **one device-pinned worker thread per chip** (:class:`DeviceWorker`):
+  each worker enters ``jax.default_device(dev)`` for its whole life, so
+  everything it runs — prewarm probes, solo jobs, coalesced batches —
+  compiles and executes on ITS chip.  jax's config contexts are
+  thread-local, so N workers pin N devices concurrently in one process;
+* **sticky bucket->device routing** (serve/scheduler.py): a dispatcher
+  thread pops coalesced batches off the admission queue and routes each
+  to the bucket's home device, because executables live per device and
+  round-robin would recompile every bucket on every chip.  Overflow
+  spills to the least-loaded warm-capable worker;
+* **a mesh-sharded "huge" tier** (:class:`MeshWorker`): buckets past the
+  single-chip ceiling (``ServeConfig.chip_max_edges``) route to a
+  reserved device group and run under a ``jax.sharding.Mesh`` whose
+  edge axis shards the slab across the group's HBM
+  (parallel/sharding.py + the explicit shard_map tail in
+  ops/sharded_tail.py) — the service accepts graphs past one chip's
+  memory instead of 413-ing them, bit-identical to the unsharded path
+  (tests/test_parallel.py parity);
+* **failure isolation**: an exception that escapes a worker's batch
+  machinery (the per-job try/excepts in serve/server.py already absorb
+  job-level errors, so an escape means the worker itself is broken)
+  cordons the worker, requeues its unfinished jobs with that device
+  excluded (``Job.excluded_devices``), and lets the survivors carry the
+  traffic.  ``/healthz`` surfaces the cordon; a job that cordons every
+  device fails as itself.
+
+Observability: every worker tags its spans with ``device=i`` and owns a
+thread-filtered :class:`analysis.CompileGuard` feeding
+``serve.device.<i>.xla_compiles``, so ``/metricsz`` breaks compiles,
+jobs and busy-time down per device and the drain-time Perfetto trace
+renders one track per device (obs/export.py thread naming).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, FrozenSet, List, Optional, Sequence
+
+from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.serve.jobs import (STATE_FAILED, STATE_QUEUED,
+                                          STATE_RUNNING, Job)
+from fastconsensus_tpu.serve.scheduler import (NoEligibleWorker,
+                                               StickyScheduler)
+
+_logger = logging.getLogger("fastconsensus_tpu")
+
+
+class _Worker:
+    """One device-driving worker thread (base: queueing + lifecycle).
+
+    The worker owns a deque of batches fed by the dispatcher, a
+    long-lived thread-filtered CompileGuard (per-device compile
+    attribution), and the residency/warmth bookkeeping the scheduler
+    routes on.  Subclasses provide the device scope (one chip vs a mesh
+    group) and how a batch executes.
+    """
+
+    kind = "chip"
+
+    def __init__(self, idx: int, service, pool) -> None:
+        self.idx = idx
+        self.service = service
+        self.pool = pool
+        self.cordoned = False
+        self.error: Optional[str] = None
+        self.jobs_done = 0
+        self.batches_done = 0
+        self.busy_s = 0.0
+        self.warm_buckets: set = set()
+        self.buckets: Dict[str, int] = {}   # residency: bucket -> jobs
+        self.prewarm_specs: List[str] = []
+        self.prewarm_left = 0
+        self.tid: Optional[int] = None      # thread ident once running
+        self._batches: "deque[List[Job]]" = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._started = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"fcpool-{self.kind}-{idx}",
+            daemon=True)
+        self._reg = obs_counters.get_registry()
+
+    # -- scheduler interface ----------------------------------------
+
+    def alive(self) -> bool:
+        """Not yet started (pre-warm assignment runs before the threads
+        do) or the thread is still running."""
+        return not self._started or self._thread.is_alive()
+
+    def eligible(self, exclude: FrozenSet[int] = frozenset()) -> bool:
+        return (not self.cordoned and self.alive()
+                and not self._closed_and_idle()
+                and self.idx not in exclude)
+
+    def load(self) -> int:
+        """Queued jobs + unfinished pre-warm specs (routing weight)."""
+        with self._cond:
+            return sum(len(b) for b in self._batches) + self.prewarm_left
+
+    def queued_jobs(self) -> int:
+        """Admitted jobs parked in this worker's deque (the admission
+        bound's view — excludes pre-warm, which consumed no queue
+        slot)."""
+        with self._cond:
+            return sum(len(b) for b in self._batches)
+
+    def _closed_and_idle(self) -> bool:
+        # a closed worker still drains its backlog, but routing new work
+        # at one that is about to exit would strand the jobs
+        with self._cond:
+            return self._closed and not self._batches
+
+    # -- dispatcher interface ---------------------------------------
+
+    def start(self) -> None:
+        self._started = True
+        self._thread.start()
+
+    def enqueue(self, batch: List[Job]) -> None:
+        with self._cond:
+            self._batches.append(batch)
+            self._cond.notify()
+
+    def close(self) -> None:
+        """Finish the backlog, then exit (drain)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify()
+
+    def join(self, timeout: Optional[float]) -> bool:
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def note_job(self, bucket: str) -> None:
+        """Residency bookkeeping (``bucket`` is the bucket key string),
+        called by the service per finished job (thread-confined to this
+        worker's thread)."""
+        self.jobs_done += 1
+        self.warm_buckets.add(bucket)
+        self.buckets[bucket] = self.buckets.get(bucket, 0) + 1
+
+    # -- the worker loop --------------------------------------------
+
+    def _device_scope(self):
+        raise NotImplementedError
+
+    def _next(self) -> Optional[List[Job]]:
+        with self._cond:
+            while True:
+                if self._batches:
+                    batch = self._batches.popleft()
+                    self._coalesce(batch)
+                    return batch
+                if self._closed:
+                    return None
+                self._cond.wait()
+
+    def _coalesce(self, batch: List[Job]) -> None:
+        """Merge immediately-following same-group deque batches into
+        ``batch`` up to ``max_batch`` (caller holds ``_cond``).
+
+        The dispatcher pops eagerly — while this worker is busy, a
+        same-bucket burst lands in the deque as single-job batches, and
+        without this re-merge the cross-request coalescing win
+        (serve/queue.py ``pop_batch``) would only survive when the
+        admission heap itself ran deep.  Order is preserved: merging
+        stops at the first batch of a different group, so nothing jumps
+        the deque."""
+        max_b = self.service.config.max_batch
+        if max_b <= 1 or not batch or self.kind == "mesh":
+            return
+        group = batch[0].spec.batch_group()
+        if any(j.spec.batch_group() != group for j in batch[1:]):
+            return  # a mixed batch never merges (and never packs)
+        merged = 0
+        while self._batches and len(batch) < max_b:
+            nxt = self._batches[0]
+            if len(batch) + len(nxt) > max_b or \
+                    any(j.spec.batch_group() != group for j in nxt):
+                break
+            batch.extend(self._batches.popleft())
+            merged += 1
+        if merged:
+            self._reg.inc("serve.pool.deque_coalesced", merged)
+
+    def _loop(self) -> None:
+        from fastconsensus_tpu.analysis import CompileGuard
+
+        self.tid = threading.get_ident()
+        batch: Optional[List[Job]] = None
+        guard = CompileGuard(
+            registry=self._reg,
+            counter=f"serve.device.{self.idx}.xla_compiles",
+            thread_ident=self.tid)
+        try:
+            with self._device_scope(), guard:
+                self._prewarm()
+                while True:
+                    batch = self._next()
+                    if batch is None:
+                        return
+                    self._run(batch)
+                    batch = None
+                    self.service._flush_trace()
+        except Exception as e:  # noqa: BLE001 — the worker is broken
+            # (per-job failures never escape _run); isolate the device,
+            # keep the pool serving
+            self._die(e, batch)
+        finally:
+            self._reg.gauge(f"serve.device.{self.idx}.busy_s",
+                            round(self.busy_s, 6))
+
+    def _prewarm(self) -> None:
+        for spec in self.prewarm_specs:
+            try:
+                self.service._prewarm_one(spec, worker=self)
+            except Exception as e:  # noqa: BLE001 — a bad warm spec
+                # must not cordon a worker before it served anything
+                self._reg.inc("serve.prewarm.failed")
+                _logger.warning("fcserve pre-warm %r failed on device "
+                                "%d: %s", spec, self.idx, e)
+            with self._cond:
+                self.prewarm_left -= 1
+            self.pool.note_prewarm_done()
+
+    def _run(self, batch: List[Job]) -> None:
+        t0 = time.perf_counter()
+        try:
+            self.service._drain_group(deque(batch), worker=self)
+        finally:
+            self.busy_s += time.perf_counter() - t0
+            self.batches_done += 1
+            self._reg.gauge(f"serve.device.{self.idx}.busy_s",
+                            round(self.busy_s, 6))
+
+    def _die(self, exc: Exception, batch: Optional[List[Job]]) -> None:
+        self.cordoned = True
+        self.error = f"{type(exc).__name__}: {exc}"
+        self._reg.inc("serve.pool.worker_deaths")
+        self._reg.inc(f"serve.device.{self.idx}.deaths")
+        _logger.exception(
+            "fcpool worker %d (%s) died; cordoning the device and "
+            "requeueing its jobs", self.idx, self.kind)
+        pending: List[Job] = list(batch or ())
+        with self._cond:
+            while self._batches:
+                pending.extend(self._batches.popleft())
+        requeue = [j for j in pending
+                   if j.state in (STATE_QUEUED, STATE_RUNNING)]
+        for job in requeue:
+            job.exclude_device(self.idx)
+            job.mark(STATE_QUEUED)
+        if requeue:
+            self._reg.inc("serve.pool.requeued_jobs", len(requeue))
+            self.pool.requeue(requeue)
+
+    def describe(self) -> dict:
+        with self._cond:
+            backlog = sum(len(b) for b in self._batches)
+            prewarm_left = self.prewarm_left
+        return {
+            "device": self.idx,
+            "kind": self.kind,
+            "alive": self.alive(),
+            "cordoned": self.cordoned,
+            "error": self.error,
+            "backlog": backlog,
+            "jobs": self.jobs_done,
+            "batches": self.batches_done,
+            "busy_s": round(self.busy_s, 3),
+            "buckets": dict(self.buckets),
+            "warm": sorted(self.warm_buckets),
+            "prewarm_pending": prewarm_left,
+        }
+
+
+class DeviceWorker(_Worker):
+    """A worker pinned to one accelerator chip."""
+
+    kind = "chip"
+
+    def __init__(self, idx: int, device, service, pool) -> None:
+        super().__init__(idx, service, pool)
+        self.device = device
+
+    def _device_scope(self):
+        import jax
+
+        return jax.default_device(self.device)
+
+
+class MeshWorker(_Worker):
+    """The huge-tier worker: drives a reserved multi-chip mesh group.
+
+    Jobs here run SOLO through ``run_consensus(mesh=...)`` — the batch
+    coalescing path is single-chip-only (run_consensus_batch), and huge
+    graphs are throughput-bound by the device anyway.  The mesh's edge
+    axis spans the whole group so the slab (the HBM-resident state)
+    shards across every reserved chip; the ensemble axis stays 1 so any
+    ``n_p`` is admissible (run_consensus requires n_p divisible by the
+    ensemble axis).
+    """
+
+    kind = "mesh"
+
+    def __init__(self, idx: int, devices: Sequence, service, pool) -> None:
+        super().__init__(idx, service, pool)
+        self.devices = list(devices)
+        self.mesh = None   # built on the worker thread, first use
+
+    def _device_scope(self):
+        from fastconsensus_tpu import parallel
+
+        self.mesh = parallel.make_mesh(ensemble=1, edge=len(self.devices),
+                                       devices=self.devices)
+        return contextlib.nullcontext()
+
+    def describe(self) -> dict:
+        out = super().describe()
+        out["mesh_devices"] = [getattr(d, "id", i)
+                               for i, d in enumerate(self.devices)]
+        return out
+
+
+class WorkerPool:
+    """Dispatcher + workers + scheduler for one ``ConsensusService``.
+
+    Built (and its device list resolved) inside ``start()`` so the
+    jax-free paths — thin clients, ``-h`` — never import jax through
+    the pool.
+    """
+
+    def __init__(self, service) -> None:
+        import jax
+
+        self.service = service
+        cfg = service.config
+        devices = list(jax.local_devices())
+        n = cfg.devices if cfg.devices is not None else len(devices)
+        if not 1 <= n <= len(devices):
+            raise ValueError(
+                f"devices={cfg.devices} out of range 1..{len(devices)}")
+        huge = int(cfg.huge_devices)
+        if huge < 0 or (huge > 0 and huge >= n):
+            raise ValueError(
+                f"huge_devices={huge} must leave at least one serving "
+                f"chip (devices={n})")
+        if cfg.chip_max_edges is not None and huge < 1:
+            raise ValueError(
+                "chip_max_edges needs a huge tier: set huge_devices >= 1")
+        if huge >= 1 and cfg.chip_max_edges is None:
+            # the mirror check: without a ceiling no bucket ever routes
+            # huge, so the reserved mesh group would sit idle forever —
+            # the exact waste the pool exists to remove
+            raise ValueError(
+                "huge_devices reserves a mesh group nothing can reach: "
+                "set chip_max_edges (the single-chip bucket ceiling)")
+        self._reg = obs_counters.get_registry()
+        self.scheduler = StickyScheduler(spill_backlog=cfg.spill_backlog)
+        # the LAST huge_devices devices form the reserved mesh group;
+        # chip workers take the rest (device ordinal == worker idx ==
+        # the fcobs `device=` tag)
+        self.chip_workers: List[DeviceWorker] = [
+            DeviceWorker(i, devices[i], service, self)
+            for i in range(n - huge)]
+        self.mesh_workers: List[MeshWorker] = []
+        if huge:
+            self.mesh_workers.append(
+                MeshWorker(n - huge, devices[n - huge: n], service, self))
+        self.workers: List[_Worker] = \
+            list(self.chip_workers) + list(self.mesh_workers)
+        self._prewarm_total = 0
+        self._prewarm_done = 0
+        self._prewarm_lock = threading.Lock()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="fcpool-dispatch",
+            daemon=True)
+
+    # -- lifecycle ---------------------------------------------------
+
+    def start(self) -> None:
+        # admitted work the dispatcher already moved into worker deques
+        # still counts against the queue's depth bound — eager dispatch
+        # must not hollow out the 429 backpressure contract
+        self.service.queue.set_extra_depth(self.backlog)
+        self._assign_prewarm()
+        for w in self.workers:
+            w.start()
+        self._dispatcher.start()
+        self._reg.gauge("serve.pool.workers", len(self.workers))
+
+    def backlog(self) -> int:
+        """Admitted-but-undispatched jobs across every worker deque
+        (the queue's ``extra_depth`` hook; running jobs don't count —
+        they hold no admission slot, exactly as before the pool)."""
+        return sum(w.queued_jobs() for w in self.workers)
+
+    def drain(self, timeout: Optional[float]) -> bool:
+        """Join the dispatcher and every worker (the queue must already
+        be closed — ConsensusService.begin_drain).  True = all exited."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        remaining = lambda: (None if deadline is None else  # noqa: E731
+                             max(0.0, deadline - time.monotonic()))
+        self._dispatcher.join(remaining())
+        ok = not self._dispatcher.is_alive()
+        for w in self.workers:
+            ok = w.join(remaining()) and ok
+        return ok
+
+    # -- pre-warm distribution ---------------------------------------
+
+    def _assign_prewarm(self) -> None:
+        """Distribute ``--warm`` specs across workers through the
+        scheduler, so each bucket's executables compile on the device
+        the routing will later send its traffic to (the sticky home IS
+        minted here, before the first request)."""
+        from fastconsensus_tpu.serve import bucketer
+
+        for spec in self.service.config.prewarm:
+            self._prewarm_total += 1
+            key = spec.partition(":")[0]
+            try:
+                bucket = bucketer.bucket_from_key(key)
+                worker = self.route_bucket(bucket.key(),
+                                           huge=self._is_huge(bucket))
+            except (ValueError, NoEligibleWorker):
+                # unparseable/ineligible specs still consume a slot so
+                # /healthz progress adds up; the worker's warm-time
+                # error path owns the counting and the log line
+                worker = self.workers[0]
+            worker.prewarm_specs.append(spec)
+            worker.prewarm_left += 1
+
+    def note_prewarm_done(self) -> None:
+        with self._prewarm_lock:
+            self._prewarm_done += 1
+
+    def prewarm_progress(self) -> dict:
+        with self._prewarm_lock:
+            done = self._prewarm_done
+        return {"specs": self._prewarm_total, "done": done,
+                "finished": done >= self._prewarm_total}
+
+    # -- routing ------------------------------------------------------
+
+    def _is_huge(self, bucket) -> bool:
+        ceiling = self.service.config.chip_max_edges
+        return bool(self.mesh_workers) and ceiling is not None \
+            and bucket.e_class > ceiling
+
+    def _classify(self, job: Job):
+        """(bucket key, huge?) for routing; specs the bucketer rejects
+        route anywhere (they will fail as their own job at pack time)."""
+        try:
+            bucket = job.spec.bucket()
+            return bucket.key(), self._is_huge(bucket)
+        except Exception:  # noqa: BLE001 — routing must never reject
+            return f"solo:{job.job_id}", False
+
+    def route_bucket(self, bucket_key: str, huge: bool,
+                     exclude: FrozenSet[int] = frozenset()) -> _Worker:
+        tier = self.mesh_workers if huge else self.chip_workers
+        return self.scheduler.route(bucket_key, tier, exclude=exclude)
+
+    def dispatch(self, batch: List[Job]) -> None:
+        """Route one coalesced pop.  Jobs requeued after a worker death
+        carry per-job exclusion sets and may mix batch groups (several
+        deque batches die together), so the batch splits by (bucket,
+        exclusions, batch group) — uniform for normal traffic, and a
+        requeued mix can never pack different configs into one batched
+        device call."""
+        groups: Dict[tuple, List[Job]] = {}
+        for job in batch:
+            bucket_key, huge = self._classify(job)
+            try:
+                group = job.spec.batch_group()
+            except Exception:  # noqa: BLE001 — routing must never
+                group = f"solo:{job.job_id}"   # reject (packs solo)
+            sig = (bucket_key, huge, job.excluded(), group)
+            groups.setdefault(sig, []).append(job)
+        for (bucket_key, huge, exclude, _group), jobs in groups.items():
+            try:
+                worker = self.route_bucket(bucket_key, huge,
+                                           exclude=exclude)
+            except NoEligibleWorker as e:
+                for job in jobs:
+                    job.mark(STATE_FAILED, error=str(e))
+                    self._reg.inc("serve.jobs.failed")
+                _logger.warning(
+                    "fcpool: failed %d job(s) of bucket %s: %s",
+                    len(jobs), bucket_key, e)
+                continue
+            worker.enqueue(jobs)
+
+    def requeue(self, jobs: List[Job]) -> None:
+        """Re-dispatch a dead worker's unfinished jobs directly (the
+        admission queue may already be closed and drained mid-shutdown,
+        so requeues never pass through it)."""
+        self.dispatch(list(jobs))
+
+    # -- the dispatcher ----------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        service = self.service
+        while True:
+            batch = service.queue.pop_batch(service.config.max_batch,
+                                            group_key=service._group_key)
+            if batch is None:
+                break  # queue closed and drained
+            self.dispatch(batch)
+        for w in self.workers:
+            w.close()
+
+    # -- introspection ------------------------------------------------
+
+    def describe(self) -> List[dict]:
+        return [w.describe() for w in self.workers]
+
+    def thread_names(self) -> Dict[int, str]:
+        """Raw thread ident -> display name, for the drain-time Perfetto
+        export (one named track per device)."""
+        names = {}
+        for w in self.workers:
+            if w.tid is not None:
+                tag = f"device-{w.idx}" if w.kind == "chip" \
+                    else f"mesh-{w.idx}"
+                names[w.tid] = tag
+        return names

@@ -57,7 +57,20 @@ class AdmissionQueue:
         self._seq = 0
         self._closed = False
         self._cond = threading.Condition()
+        self._extra_depth: Optional[Callable[[], int]] = None
         self._reg = obs_counters.get_registry()
+
+    def set_extra_depth(self, fn: Callable[[], int]) -> None:
+        """Count admitted-but-undispatched jobs parked OUTSIDE the heap
+        toward the depth bound.  The fcpool dispatcher (serve/pool.py)
+        eagerly moves popped batches into per-worker deques; without
+        this hook that would hollow out the backpressure contract — the
+        heap would drain in microseconds and a depth-1 queue would
+        absorb an unbounded burst into worker backlogs.  ``fn`` is
+        called under the queue lock and must not take the queue lock
+        itself (worker deque locks are always acquired after it)."""
+        with self._cond:
+            self._extra_depth = fn
 
     def submit(self, job: Job) -> None:
         """Admit ``job`` or raise :class:`QueueFull` /
@@ -66,9 +79,11 @@ class AdmissionQueue:
             if self._closed:
                 self._reg.inc("serve.queue.rejected_draining")
                 raise QueueClosed("service is draining; not accepting jobs")
-            if len(self._heap) >= self.max_depth:
+            depth = len(self._heap) + (self._extra_depth()
+                                       if self._extra_depth else 0)
+            if depth >= self.max_depth:
                 self._reg.inc("serve.queue.rejected_full")
-                raise QueueFull(len(self._heap), self.max_depth)
+                raise QueueFull(depth, self.max_depth)
             self._seq += 1
             heapq.heappush(self._heap, (job.spec.priority, self._seq, job))
             self._reg.inc("serve.queue.admitted")

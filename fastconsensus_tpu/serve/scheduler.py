@@ -1,0 +1,108 @@
+"""fcpool scheduler: sticky bucket->device affinity routing.
+
+The pool's whole throughput story rests on one fact about jit: compiled
+executables live per *device* — a bucket's round/batch executables
+compiled on chip 3 serve chip 3 only, and running the same bucket on
+chip 5 compiles the entire set again (minutes on a real TPU).  Routing
+therefore cannot be round-robin: it must send same-bucket work back to
+the device that already holds the bucket's executables.  That is the
+**sticky home**: the first time a bucket is routed, the least-loaded
+eligible worker becomes its home, and every later batch of that bucket
+lands there — zero warm compiles, the serve/bucketer.py contract
+extended across devices.
+
+Stickiness is not absolute, because a hot bucket would otherwise turn
+the pool back into a single chip.  When the home's backlog exceeds
+``spill_backlog`` queued jobs, the batch **spills** to the least-loaded
+eligible worker — preferring workers that already ran this bucket (they
+hold warm executables; spilling there costs nothing) and falling back to
+a cold worker only when no warm one exists (paying one compile set to
+mint a second home, which the warm-preference then reuses forever).
+
+Cordoning: a worker that died (serve/pool.py failure isolation) is never
+routed to again, and a job that *killed* a worker carries that device in
+its exclusion set (``Job.excluded_devices``) so the requeue cannot
+bounce it back.  A bucket whose home is cordoned is re-homed on its next
+batch.  When no eligible worker remains, :class:`NoEligibleWorker`
+propagates and the caller fails the jobs explicitly — a poisoned job
+that cordons every device must end as ITS failure, not an infinite
+requeue loop.
+
+Workers are duck-typed (tests drive the scheduler with plain stubs):
+``idx`` (int device tag), ``eligible(exclude)`` (alive, not cordoned,
+not excluded), ``load()`` (queued jobs + unfinished pre-warm specs) and
+``warm_buckets`` (set of bucket keys this worker has executed).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, FrozenSet, Optional, Sequence
+
+from fastconsensus_tpu.obs import counters as obs_counters
+
+
+class NoEligibleWorker(RuntimeError):
+    """Every worker for the tier is cordoned, dead, or excluded."""
+
+
+class StickyScheduler:
+    """Route buckets to workers; see the module docstring."""
+
+    def __init__(self, spill_backlog: int = 8) -> None:
+        if spill_backlog < 0:
+            raise ValueError(
+                f"spill_backlog must be >= 0, got {spill_backlog}")
+        self.spill_backlog = int(spill_backlog)
+        self._affinity: Dict[str, int] = {}   # bucket key -> worker idx
+        self._lock = threading.Lock()
+        self._reg = obs_counters.get_registry()
+
+    def affinity(self) -> Dict[str, int]:
+        """Snapshot of the bucket -> home-device map (``/healthz``)."""
+        with self._lock:
+            return dict(self._affinity)
+
+    def route(self, bucket: str, workers: Sequence,
+              exclude: FrozenSet[int] = frozenset()):
+        """The worker that should run the next batch of ``bucket``.
+
+        ``workers`` is the tier's worker list (chip workers for normal
+        buckets, mesh workers for huge ones — serve/pool.py picks the
+        tier before calling).  Raises :class:`NoEligibleWorker` when
+        nothing can take the work.
+        """
+        candidates = [w for w in workers if w.eligible(exclude)]
+        if not candidates:
+            raise NoEligibleWorker(
+                f"no eligible worker for bucket {bucket!r} "
+                f"(excluded: {sorted(exclude)})")
+        with self._lock:
+            home_idx = self._affinity.get(bucket)
+            home = next((w for w in candidates if w.idx == home_idx),
+                        None)
+            if home is not None and home.load() <= self.spill_backlog:
+                self._reg.inc("serve.sched.sticky_hits")
+                return home
+            # spill (home overloaded) or first/renewed assignment (no
+            # home, or the home is cordoned/excluded): least-loaded,
+            # warm-capable first
+            warm = [w for w in candidates if bucket in w.warm_buckets
+                    and w is not home]
+            pool = warm or [w for w in candidates if w is not home] \
+                or candidates
+            pick = min(pool, key=lambda w: (w.load(), w.idx))
+            if home_idx is None:
+                # sticky home minted where the bucket will compile
+                self._affinity[bucket] = pick.idx
+                self._reg.inc("serve.sched.assigns")
+            elif home is None:
+                # the recorded home is cordoned/excluded: re-home the
+                # bucket where its work lands now
+                self._affinity[bucket] = pick.idx
+                self._reg.inc("serve.sched.rehomes")
+            else:
+                self._reg.inc("serve.sched.spills")
+                if bucket not in pick.warm_buckets:
+                    self._reg.inc("serve.sched.spill_cold")
+            return pick

@@ -22,12 +22,21 @@ process whose jitted executables are reused across requests:
   says so (503) — accepted work always finishes.
 
 Threading model: HTTP handler threads (stdlib ``ThreadingHTTPServer``)
-only touch the queue / cache / jobs table; a SINGLE worker thread drives
-the device.  That is deliberate, not a simplification — one accelerator
-serializes executions anyway, and a single dispatch thread keeps jit
-caches, fcobs counters and the CompileGuard accounting race-free.
-Throughput comes from amortizing compiles and skipping cached work, not
-from concurrent device entry.
+only touch the queue / cache / jobs table; the device side is the
+**fcpool worker pool** (serve/pool.py) — one device-pinned worker
+thread per chip, fed by a dispatcher that pops coalesced batches and
+routes them by sticky bucket->device affinity (serve/scheduler.py), so
+executable reuse survives the fan-out (a bucket's executables live on
+the device that compiled them; round-robin would recompile every bucket
+on every chip).  Each worker owns a thread-filtered CompileGuard and
+``device=i`` span/counter tags, so ``/metricsz`` attributes compiles,
+jobs and busy-time per device.  Buckets past the single-chip ceiling
+(``chip_max_edges``) route to a reserved mesh group and run
+edge-sharded via ``shard_map`` (the "huge" tier) instead of 413-ing.
+A worker that dies mid-batch is cordoned (visible in ``/healthz``) and
+its jobs requeue with that device excluded.  ``devices=1`` (or a
+single-chip machine) reproduces the former single-worker behavior
+exactly.
 
 Shutdown: SIGTERM (serve/__main__.py) closes the queue, finishes every
 admitted job, optionally exports the server's own fcobs trace artifacts
@@ -131,6 +140,22 @@ class ServeConfig:
     # closure_sampler / closure_tau, so pre-warm only pays off when
     # these match the traffic; seed and max_rounds are traced and free.
     prewarm_config: Optional[Dict[str, Any]] = None
+    # Multi-device serving (serve/pool.py): how many local devices the
+    # pool drives (None = all of them; 1 = the single-worker posture).
+    devices: Optional[int] = None
+    # Devices reserved (off the END of the device list) for the
+    # mesh-sharded "huge" tier.  0 disables the tier.
+    huge_devices: int = 0
+    # Single-chip bucket ceiling: buckets whose edge class exceeds this
+    # route to the huge tier (edge-sharded across the reserved mesh
+    # group) instead of a single chip.  Requires huge_devices >= 1.
+    # None = every admitted bucket runs single-chip (the max_edges 413
+    # bound still applies either way).
+    chip_max_edges: Optional[int] = None
+    # Sticky-affinity spill threshold (serve/scheduler.py): a bucket's
+    # batches leave their home device only when the home has more than
+    # this many jobs queued.
+    spill_backlog: int = 8
 
 
 class ConsensusService:
@@ -143,8 +168,9 @@ class ConsensusService:
                                  ttl_seconds=self.config.cache_ttl_s)
         self._jobs: "OrderedDict[str, Job]" = OrderedDict()
         self._lock = threading.Lock()
-        self._worker: Optional[threading.Thread] = None
+        self.pool = None   # serve/pool.WorkerPool, built in start()
         self._tracer = None
+        self._trace_lock = threading.Lock()
         self._trace_jsonl: Optional[str] = None
         self._streamed_events = 0
         self._buckets: Dict[str, int] = {}
@@ -158,8 +184,8 @@ class ConsensusService:
     # -- lifecycle ---------------------------------------------------
 
     def start(self) -> "ConsensusService":
-        """Launch the worker thread (idempotent)."""
-        if self._worker is not None:
+        """Build the device worker pool and launch it (idempotent)."""
+        if self.pool is not None:
             return self
         if self.config.pin_sizing:
             os.environ.setdefault("FCTPU_DETECT_CALL_MEMBERS", "0")
@@ -180,9 +206,10 @@ class ConsensusService:
             n = self.cache.load(self.config.cache_path)
             _logger.info("fcserve: reloaded %d cached result(s) from %s",
                          n, self.config.cache_path)
-        self._worker = threading.Thread(target=self._worker_loop,
-                                        name="fcserve-worker", daemon=True)
-        self._worker.start()
+        from fastconsensus_tpu.serve.pool import WorkerPool
+
+        self.pool = WorkerPool(self)
+        self.pool.start()
         return self
 
     def begin_drain(self) -> None:
@@ -190,14 +217,14 @@ class ConsensusService:
         self.queue.close()
 
     def drain(self, timeout: Optional[float] = None) -> bool:
-        """Graceful shutdown: close intake, finish every admitted job,
-        export the server trace (``trace_dir``).  True = fully drained."""
+        """Graceful shutdown: close intake, finish every admitted job on
+        every worker, export ONE merged trace with per-device tracks
+        (``trace_dir``).  True = fully drained."""
         self.begin_drain()
         ok = True
-        if self._worker is not None:
-            self._worker.join(timeout if timeout is not None
-                              else self.config.drain_timeout_s)
-            ok = not self._worker.is_alive()
+        if self.pool is not None:
+            ok = self.pool.drain(timeout if timeout is not None
+                                 else self.config.drain_timeout_s)
         if ok:
             if self.config.cache_path:
                 n = self.cache.spill(self.config.cache_path)
@@ -205,33 +232,41 @@ class ConsensusService:
                              n, self.config.cache_path)
             self._export_trace()
         else:
-            # the worker is STILL RUNNING a job: exporting now would
-            # race its per-job _flush_trace on the stream index and the
-            # .jsonl file (duplicate/desynced records); the streamed
-            # .jsonl up to the last finished job is already on disk
+            # some worker is STILL RUNNING a job: exporting now would
+            # race its per-batch _flush_trace on the stream index and
+            # the .jsonl file (duplicate/desynced records); the streamed
+            # .jsonl up to the last finished batch is already on disk
             _logger.warning(
                 "fcserve drain timed out with a job in flight; "
                 "skipping trace export (streamed .jsonl is intact)")
         return ok
 
     def _flush_trace(self) -> None:
-        """Stream newly finished spans to the .jsonl (once per job) and
-        bound resident span memory: past TRACE_EVENT_WINDOW streamed
+        """Stream newly finished spans to the .jsonl (once per batch)
+        and bound resident span memory: past TRACE_EVENT_WINDOW streamed
         spans the in-memory list resets — the history is already on
         disk, and a heavy-traffic server must not retain every span of
-        every request until drain.  Only the worker thread opens spans,
-        so the between-jobs clear races nothing."""
+        every request until drain.  Every pool worker calls this between
+        batches, so the stream index and the reset are serialized under
+        their own lock."""
         if self._tracer is None or self._trace_jsonl is None:
             return
-        new = self._tracer.events_since(self._streamed_events)
-        if new:
+        with self._trace_lock:
+            new = self._tracer.events_since(self._streamed_events)
             self._streamed_events += len(new)
-            with open(self._trace_jsonl, "a", encoding="utf-8") as fh:
-                for ev in new:
-                    fh.write(json.dumps({"kind": "span", **ev}) + "\n")
-        if self._streamed_events > TRACE_EVENT_WINDOW:
-            self._tracer.clear()
-            self._streamed_events = 0
+            if self._streamed_events > TRACE_EVENT_WINDOW:
+                # atomic snapshot+clear (Tracer.drain_since): a span
+                # another worker closes between a separate read and
+                # clear() would vanish from memory AND the stream
+                new = new + self._tracer.drain_since(
+                    self._streamed_events)
+                self._streamed_events = 0
+            if new:
+                with open(self._trace_jsonl, "a",
+                          encoding="utf-8") as fh:
+                    for ev in new:
+                        fh.write(json.dumps({"kind": "span", **ev})
+                                 + "\n")
 
     def _export_trace(self) -> None:
         if self._tracer is None or not self.config.trace_dir:
@@ -243,11 +278,14 @@ class ConsensusService:
         self._flush_trace()
         snapshot = self._reg.snapshot()
         # Perfetto blob from the retained (recent-window) spans; the
-        # complete stream is the .jsonl next to it
+        # complete stream is the .jsonl next to it.  Worker threads map
+        # to named per-device tracks ("device-0", "mesh-6", ...).
         events = self._tracer.events()
         path = os.path.join(self.config.trace_dir, "fcserve_trace.json")
+        thread_names = self.pool.thread_names() if self.pool else None
         obs_export.write_perfetto(path, events, snapshot,
-                                  process_name="fcserve")
+                                  process_name="fcserve",
+                                  thread_names=thread_names)
         with open(self._trace_jsonl, "a", encoding="utf-8") as fh:
             fh.write(json.dumps({"kind": "counters", **snapshot}) + "\n")
         _logger.info("fcserve trace written to %s (+.jsonl)", path)
@@ -331,17 +369,7 @@ class ConsensusService:
                 else:
                     break  # everything retained is live work
 
-    # -- the worker --------------------------------------------------
-
-    def _worker_loop(self) -> None:
-        self._prewarm_all()
-        while True:
-            batch = self.queue.pop_batch(self.config.max_batch,
-                                         group_key=self._group_key)
-            if batch is None:
-                return  # queue closed and drained
-            self._drain_group(deque(batch))
-            self._flush_trace()
+    # -- the worker paths (driven by serve/pool.py workers) -----------
 
     def _group_key(self, job: Job) -> str:
         try:
@@ -351,10 +379,12 @@ class ConsensusService:
             # group key guarantees it never coalesces
             return f"solo:{job.job_id}"
 
-    def _drain_group(self, pending: "deque[Job]") -> None:
+    def _drain_group(self, pending: "deque[Job]", worker=None) -> None:
         """Run one coalesced pop: answer cache hits, then execute the
         rest at batch-ladder rungs (one batched device call per rung,
-        solo for a rung of 1)."""
+        solo for a rung of 1).  On a mesh (huge-tier) worker every job
+        runs solo — the batch path is single-chip only, and huge jobs
+        are device-bound, not dispatch-bound."""
         runnable: List[Job] = []
         for job in pending:
             cached = self.cache.get(job.key, count_miss=False)
@@ -365,21 +395,26 @@ class ConsensusService:
                 self._reg.inc("serve.jobs.completed")
             else:
                 runnable.append(job)
+        solo_only = worker is not None and worker.kind == "mesh"
         while runnable:
-            rung = bucketer.batch_rung(min(len(runnable),
-                                           self.config.max_batch))
+            rung = 1 if solo_only else bucketer.batch_rung(
+                min(len(runnable), self.config.max_batch))
             chunk, runnable = runnable[:rung], runnable[rung:]
             if len(chunk) == 1:
-                self._run_solo_job(chunk[0])
+                self._run_solo_job(chunk[0], worker=worker)
             else:
-                self._run_batch(chunk)
+                self._run_batch(chunk, worker=worker)
 
-    def _run_solo_job(self, job: Job) -> None:
+    def _run_solo_job(self, job: Job, worker=None) -> None:
         job.mark(STATE_RUNNING)
+        if worker is not None:
+            job.set_device(worker.idx)
         try:
-            result = self.run_spec(job.spec, key=job.key)
+            result = self.run_spec(job.spec, key=job.key, worker=worker)
             job.mark(STATE_DONE, result=result)
             self._reg.inc("serve.jobs.completed")
+            if worker is not None:
+                self._reg.inc(f"serve.device.{worker.idx}.jobs")
         except Exception as e:  # noqa: BLE001 — one bad job must
             # never take down the worker (and with it every queued
             # job behind it); the failure is the job's result
@@ -388,7 +423,7 @@ class ConsensusService:
             _logger.warning("fcserve job %s failed: %s", job.job_id,
                             job.error)
 
-    def _run_batch(self, jobs: List[Job]) -> None:
+    def _run_batch(self, jobs: List[Job], worker=None) -> None:
         """Execute >= 2 same-group jobs as ONE batched device call.
 
         Failure isolation, in order: a job whose graph fails to pack
@@ -401,6 +436,8 @@ class ConsensusService:
         packed: List[Tuple] = []  # (job, normalized spec, slab, bucket)
         for job in jobs:
             job.mark(STATE_RUNNING)
+            if worker is not None:
+                job.set_device(worker.idx)
             spec = self._normalize_spec(job.spec)
             try:
                 slab, bucket = bucketer.pad_to_bucket(
@@ -423,11 +460,11 @@ class ConsensusService:
             rung = bucketer.batch_rung(len(packed))
             chunk, packed = packed[:rung], packed[rung:]
             if len(chunk) == 1:
-                self._run_solo_job(chunk[0][0])
+                self._run_solo_job(chunk[0][0], worker=worker)
             else:
-                self._run_packed(chunk)
+                self._run_packed(chunk, worker=worker)
 
-    def _run_packed(self, packed: List[Tuple]) -> None:
+    def _run_packed(self, packed: List[Tuple], worker=None) -> None:
         """One batched device call over already-packed (job, spec, slab,
         bucket) rows (a ladder rung of >= 2)."""
         from fastconsensus_tpu.analysis import CompileGuard
@@ -440,13 +477,17 @@ class ConsensusService:
         seeds = [spec.config.seed for _, spec, _, _ in packed]
         detect = get_detector(cfg0.algorithm, gamma=cfg0.gamma)
         tracer = get_tracer()
+        device = worker.idx if worker is not None else None
         t0 = time.perf_counter()
+        # thread-filtered: concurrent pool workers compile in parallel,
+        # and this job-scoped count must not absorb a neighbor's builds
         guard = CompileGuard(registry=self._reg,
-                             counter="serve.xla_compiles")
+                             counter="serve.xla_compiles",
+                             thread_ident=threading.get_ident())
         try:
             with tracer.span("serve.batch", bucket=bucket.key(),
                              alg=cfg0.algorithm, b=len(packed),
-                             batch_id=batch_id):
+                             batch_id=batch_id, device=device):
                 with guard:
                     results = run_consensus_batch(
                         [slab for _, _, slab, _ in packed], detect,
@@ -458,7 +499,7 @@ class ConsensusService:
                             "members solo", batch_id, e)
             self._reg.inc("serve.batch.fallback_solo")
             for job, _, _, _ in packed:
-                self._run_solo_job(job)
+                self._run_solo_job(job, worker=worker)
             return
         elapsed = time.perf_counter() - t0
         # batch metadata and coalescing metrics record only batches
@@ -467,27 +508,36 @@ class ConsensusService:
         # that never happened
         for job, _, _, _ in packed:
             job.set_batch(batch_id, len(packed))
+            if worker is not None:
+                job.set_device(worker.idx)
         self._reg.inc("serve.batch.coalesced")
         self._reg.inc("serve.batch.occupancy", len(packed))
         self._reg.gauge("serve.batch.last_size", len(packed))
         self._reg.observe("serve.batch.seconds", elapsed)
+        if worker is not None:
+            self._reg.inc(f"serve.device.{worker.idx}.batches")
         for (job, spec, _, _), res in zip(packed, results):
             with tracer.span("serve.job", bucket=bucket.key(),
-                             alg=cfg0.algorithm, batch_id=batch_id):
+                             alg=cfg0.algorithm, batch_id=batch_id,
+                             device=device):
                 result = self._finish_result(
                     spec, job.key, bucket, res.partitions,
                     rounds=res.rounds, converged=res.converged,
                     compiles=guard.count, elapsed=elapsed,
-                    batch_id=batch_id, batch_size=len(packed))
+                    batch_id=batch_id, batch_size=len(packed),
+                    worker=worker)
             job.mark(STATE_DONE, result=result)
             self._reg.inc("serve.jobs.completed")
+            if worker is not None:
+                self._reg.inc(f"serve.device.{worker.idx}.jobs")
             self._reg.observe("serve.job.seconds", elapsed / len(packed))
 
     def _finish_result(self, spec: JobSpec, key: str, bucket,
                        partitions_raw, rounds: int, converged: bool,
                        compiles: int, elapsed: float,
                        batch_id: Optional[str] = None,
-                       batch_size: int = 1) -> Dict[str, Any]:
+                       batch_size: int = 1,
+                       worker=None) -> Dict[str, Any]:
         """Slice off bucket padding, recompact ids, fill the cache —
         the shared tail of the solo and batched execution paths."""
         partitions = []
@@ -512,6 +562,10 @@ class ConsensusService:
         if batch_id is not None:
             result["batch_id"] = batch_id
             result["batch_size"] = batch_size
+        if worker is not None:
+            result["device"] = worker.idx
+            result["tier"] = worker.kind
+            worker.note_job(bucket.key())
         self.cache.put(key, result)
         with self._lock:
             self._buckets[bucket.key()] = \
@@ -520,10 +574,13 @@ class ConsensusService:
 
     # -- pre-warm ----------------------------------------------------
 
-    def _prewarm_all(self) -> None:
+    def _prewarm_all(self, worker=None) -> None:
+        """Warm every configured bucket from the calling thread — the
+        embedded/single-worker path (pool workers warm their own
+        assigned subset via ``_prewarm_one`` instead)."""
         for spec in self.config.prewarm:
             try:
-                self._prewarm_one(spec)
+                self._prewarm_one(spec, worker=worker)
             except Exception as e:  # noqa: BLE001 — a bad warm spec
                 # must not kill the worker before it served anything
                 self._reg.inc("serve.prewarm.failed")
@@ -531,12 +588,14 @@ class ConsensusService:
             self._prewarm_done += 1
         self._prewarm_finished = True
 
-    def _prewarm_one(self, spec: str) -> None:
+    def _prewarm_one(self, spec: str, worker=None) -> None:
         """Compile one bucket's executables before the first request:
         ``"n64_e96"`` warms the solo path, ``"n64_e96:4"`` also the
         batch ladder up to rung 4 — deterministic probe graphs driven
         through the REAL solo/batched paths (results discarded, cache
         untouched), compiles counted under ``serve.prewarm.compiles``.
+        On a mesh (huge-tier) worker only the solo sharded path warms —
+        batches never run there.
         """
         from fastconsensus_tpu.analysis import CompileGuard
         from fastconsensus_tpu.consensus import (ConsensusConfig,
@@ -555,6 +614,10 @@ class ConsensusService:
                     f"--warm {spec!r}: rung must be >= 1")
             max_b = min(int(b), self.config.max_batch)
         bucket = bucketer.bucket_from_key(key)
+        mesh = None
+        if worker is not None and worker.kind == "mesh":
+            mesh = worker.mesh
+            max_b = 1
         # tau defaults from the RESOLVED algorithm, mirroring the
         # request path (_parse_spec's DEFAULT_TAU[alg] setdefault): tau
         # is a jit-static, so a louvain-tau probe for an infomap warm
@@ -565,11 +628,13 @@ class ConsensusService:
         cfg = ConsensusConfig(**cfg_kwargs)
         detect = get_detector(cfg.algorithm, gamma=cfg.gamma)
         tracer = get_tracer()
+        device = worker.idx if worker is not None else None
         t0 = time.perf_counter()
         guard = CompileGuard(registry=self._reg,
-                             counter="serve.prewarm.compiles")
+                             counter="serve.prewarm.compiles",
+                             thread_ident=threading.get_ident())
         with tracer.span("serve.prewarm", bucket=bucket.key(),
-                         alg=cfg.algorithm, max_b=max_b):
+                         alg=cfg.algorithm, max_b=max_b, device=device):
             with guard:
                 for rung in bucketer.BATCH_LADDER:
                     if rung > max_b:
@@ -583,26 +648,32 @@ class ConsensusService:
                             bucket.n_class)
                         slabs.append(slab)
                     if rung == 1:
-                        run_consensus(slabs[0], detect, cfg,
+                        run_consensus(slabs[0], detect, cfg, mesh=mesh,
                                       n_closure=bucket.n_closure)
                     else:
                         run_consensus_batch(
                             slabs, detect, cfg,
                             n_closure=bucket.n_closure,
                             seeds=list(range(rung)))
+        if worker is not None:
+            worker.warm_buckets.add(bucket.key())
         self._reg.inc("serve.prewarm.buckets")
         _logger.info(
-            "fcserve pre-warmed %s ladder to B=%d (%d compiles, %.1fs)",
-            bucket.key(), max_b, guard.count, time.perf_counter() - t0)
+            "fcserve pre-warmed %s ladder to B=%d on device %s "
+            "(%d compiles, %.1fs)", bucket.key(), max_b,
+            "-" if device is None else device, guard.count,
+            time.perf_counter() - t0)
 
-    def run_spec(self, spec: JobSpec,
-                 key: Optional[str] = None) -> Dict[str, Any]:
+    def run_spec(self, spec: JobSpec, key: Optional[str] = None,
+                 worker=None) -> Dict[str, Any]:
         """Run one spec to a result payload (cache-aware, synchronous).
 
         This is the worker's core, callable directly (tests, embedded
         use).  Compiles during the run are counted live into the fcobs
         registry (``serve.xla_compiles``); a request landing in a warm
-        bucket counts zero — the serving contract.
+        bucket counts zero — the serving contract.  On a mesh worker the
+        run executes edge-sharded over the reserved device group
+        (``run_consensus(mesh=...)`` — the huge tier).
         """
         from fastconsensus_tpu.analysis import CompileGuard
         from fastconsensus_tpu.consensus import run_consensus
@@ -617,6 +688,8 @@ class ConsensusService:
         cached = self.cache.get(key, count_miss=False)
         if cached is not None:
             return dict(cached, cached=True)
+        mesh = worker.mesh if worker is not None \
+            and worker.kind == "mesh" else None
         slab, bucket = bucketer.pad_to_bucket(
             spec.edges, spec.n_nodes, spec.weights,
             max_nodes=self.config.max_nodes,
@@ -627,20 +700,22 @@ class ConsensusService:
         detect = get_detector(spec.config.algorithm,
                               gamma=spec.config.gamma)
         tracer = get_tracer()
+        device = worker.idx if worker is not None else None
         t0 = time.perf_counter()
         guard = CompileGuard(registry=self._reg,
-                             counter="serve.xla_compiles")
+                             counter="serve.xla_compiles",
+                             thread_ident=threading.get_ident())
         with tracer.span("serve.job", bucket=bucket.key(),
-                         alg=spec.config.algorithm):
+                         alg=spec.config.algorithm, device=device):
             with guard:
-                res = run_consensus(slab, detect, spec.config,
+                res = run_consensus(slab, detect, spec.config, mesh=mesh,
                                     n_closure=bucket.n_closure)
         elapsed = time.perf_counter() - t0
         result = self._finish_result(spec, key, bucket, res.partitions,
                                      rounds=res.rounds,
                                      converged=res.converged,
                                      compiles=guard.count,
-                                     elapsed=elapsed)
+                                     elapsed=elapsed, worker=worker)
         self._reg.observe("serve.job.seconds", elapsed)
         return result
 
@@ -652,6 +727,16 @@ class ConsensusService:
             for j in self._jobs.values():
                 states[j.state] = states.get(j.state, 0) + 1
             buckets = dict(self._buckets)
+        if self.pool is not None:
+            prewarm = self.pool.prewarm_progress()
+            workers = self.pool.describe()
+            affinity = self.pool.scheduler.affinity()
+            cordoned = [w["device"] for w in workers if w["cordoned"]]
+        else:
+            prewarm = {"specs": self._prewarm_total,
+                       "done": self._prewarm_done,
+                       "finished": self._prewarm_finished}
+            workers, affinity, cordoned = [], {}, []
         return {
             "uptime_s": round(time.time() - self._started_at, 3),
             "draining": self.queue.draining(),
@@ -661,10 +746,36 @@ class ConsensusService:
             "jobs": states,
             "buckets": buckets,
             "max_batch": self.config.max_batch,
-            "prewarm": {"specs": self._prewarm_total,
-                        "done": self._prewarm_done,
-                        "finished": self._prewarm_finished},
+            "prewarm": prewarm,
+            "workers": workers,
+            "affinity": affinity,
+            "cordoned_devices": cordoned,
         }
+
+    def device_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-device breakdown for ``/metricsz``: jobs, batches,
+        busy-fraction from the pool's own (service-scoped) bookkeeping,
+        compiles/deaths from the ``serve.device.<i>.*`` fcobs counters
+        (process-scoped, like every other /metricsz counter)."""
+        counters = self._reg.counters()
+        uptime = max(time.time() - self._started_at, 1e-9)
+        out: Dict[str, Dict[str, Any]] = {}
+        for w in (self.pool.describe() if self.pool is not None else []):
+            i = w["device"]
+            pref = f"serve.device.{i}."
+            out[str(i)] = {
+                "kind": w["kind"],
+                "jobs": w["jobs"],
+                "batches": w["batches"],
+                "xla_compiles": counters.get(pref + "xla_compiles", 0),
+                "deaths": counters.get(pref + "deaths", 0),
+                "busy_s": w["busy_s"],
+                "busy_frac": round(w["busy_s"] / uptime, 4),
+                "backlog": w["backlog"],
+                "cordoned": w["cordoned"],
+                "warm_buckets": len(w["warm"]),
+            }
+        return out
 
 
 # ---------------------------------------------------------------------
@@ -825,7 +936,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if path == "/metricsz":
             self._send(200, {"fcobs": self.service._reg.snapshot(),
-                             "serve": self.service.stats()})
+                             "serve": self.service.stats(),
+                             "devices": self.service.device_stats()})
             return
         for prefix in ("/status/", "/result/"):
             if path.startswith(prefix):

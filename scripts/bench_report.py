@@ -84,6 +84,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if not args.quiet:
         print(history.trend_table(groups, markdown=args.markdown))
+        devices = history.device_table(groups, markdown=args.markdown)
+        if devices:
+            # multi-device serving artifacts (serve_multichip) carry a
+            # per-device jobs/compiles/busy breakdown — render it so
+            # the report answers "which chips did the work"
+            print()
+            print(devices)
     if not args.check:
         return 0
     problems = history.check_history(groups,

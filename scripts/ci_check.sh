@@ -309,6 +309,122 @@ kill -TERM "$SERVE_PID"
 wait "$SERVE_PID"
 SERVE_PID=""
 
+echo "== fcpool: multi-device smoke (8 fake devices, sticky routing) =="
+POOL_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR" "$SERVE_DIR" "$BATCH_DIR" "$POOL_DIR"; [ -n "$SERVE_PID" ] && kill -9 "$SERVE_PID" 2>/dev/null' EXIT
+POOL_PORT=$(python - <<'PYEOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+PYEOF
+)
+# 8 virtual devices, 4 chip workers: a mixed-bucket burst must spread
+# across sticky homes (one device per bucket), the other workers must
+# compile NOTHING, and the SIGTERM drain must export one merged trace
+# with per-device tracks.
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m fastconsensus_tpu.serve --host 127.0.0.1 \
+    --port "$POOL_PORT" --queue-depth 32 --devices 4 --max-batch 2 \
+    --trace-dir "$POOL_DIR" --quiet &
+SERVE_PID=$!
+JAX_PLATFORMS=cpu python - "$POOL_PORT" <<'PYEOF'
+import sys
+import time
+
+from fastconsensus_tpu.serve.client import ServeClient
+
+client = ServeClient(f"http://127.0.0.1:{int(sys.argv[1])}", timeout=30.0)
+for _ in range(300):          # wait out server startup (jax import)
+    try:
+        client.healthz()
+        break
+    except Exception:
+        time.sleep(0.2)
+else:
+    sys.exit("fcpool server never came up")
+workers = client.workers()
+assert len(workers) == 4, workers
+assert all(w.kind == "chip" and not w.cordoned for w in workers)
+
+
+def ring(n, chords):
+    rows = [[i, (i + 1) % n] for i in range(n)]
+    rows += [[c % n, (c + 7) % n] for c in range(chords)]
+    return rows
+
+
+# mixed-bucket burst: 3 jobs in n64_e96 + 3 in n128_e192
+subs = []
+for seed in (1, 2, 3):
+    subs.append(("A", client.submit(edges=ring(40, 40), n_nodes=40,
+                                    n_p=4, max_rounds=2, seed=seed)))
+for seed in (1, 2, 3):
+    subs.append(("B", client.submit(edges=ring(100, 60), n_nodes=100,
+                                    n_p=4, max_rounds=2, seed=seed)))
+by_bucket = {}
+for tag, sub in subs:
+    res = client.wait(sub["job_id"], timeout=600)
+    by_bucket.setdefault(tag, set()).add(res["device"])
+# sticky affinity: every job of one bucket ran on ONE device...
+assert all(len(devs) == 1 for devs in by_bucket.values()), by_bucket
+used = {d for devs in by_bucket.values() for d in devs}
+# ...and the two buckets spread over two distinct sticky homes
+assert len(used) == 2, by_bucket
+devs = client.device_metrics()
+assert sum(d["jobs"] for d in devs.values()) == 6, devs
+# per-device compile counts: only the sticky homes compiled anything
+for i, d in devs.items():
+    if int(i) in used:
+        assert d["xla_compiles"] > 0, (i, d)
+    else:
+        assert d["xla_compiles"] == 0, (i, d)
+h = client.healthz()
+assert h["ok"] and not h["cordoned_devices"], h
+assert set(h["affinity"].values()) == used, h["affinity"]
+print(f"fcpool smoke ok: buckets {sorted(by_bucket)} pinned to devices "
+      f"{sorted(used)}, foreign compiles 0")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcpool multi-device smoke failed (exit $rc)" >&2
+    exit $rc
+fi
+kill -TERM "$SERVE_PID"
+wait "$SERVE_PID"
+rc=$?
+SERVE_PID=""
+if [ $rc -ne 0 ]; then
+    echo "fcpool server did not drain cleanly on SIGTERM (exit $rc)" >&2
+    exit $rc
+fi
+python - "$POOL_DIR" <<'PYEOF'
+import json
+import os
+import sys
+
+path = os.path.join(sys.argv[1], "fcserve_trace.json")
+blob = json.load(open(path))
+tracks = sorted(e["args"]["name"] for e in blob["traceEvents"]
+                if e.get("name") == "thread_name"
+                and e["args"]["name"].startswith("device-"))
+assert len(tracks) >= 2, f"expected >=2 per-device tracks, got {tracks}"
+tagged = {e["args"]["device"] for e in blob["traceEvents"]
+          if e.get("cat") == "fcobs"
+          and e.get("args", {}).get("device") is not None}
+assert len(tagged) >= 2, f"device-tagged spans on {tagged}"
+counters = blob["otherData"]["counters"]["counters"]
+assert counters.get("serve.jobs.completed", 0) >= 6, counters
+print(f"fcpool drain ok: merged trace has device tracks {tracks}, "
+      f"spans tagged for devices {sorted(tagged)}")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcpool drain-time trace lacks per-device tracks (exit $rc)" >&2
+    exit $rc
+fi
+
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"
     exit 0

@@ -1,0 +1,20 @@
+"""BAD: the same key drawn from through a helper and again directly.
+
+``jitter`` consumes its key (one random.bits draw — the seg.pair_jitter
+shape), so the caller's second draw on the same key correlates with the
+helper's: the cross-function key-reuse pass weights the helper call by
+its summarized consumption and flags the reuse, naming the helper.
+"""
+
+import jax
+
+
+def jitter(key, node):
+    salt = jax.random.bits(key, (2,), "uint32")
+    return node * salt[0] + salt[1]
+
+
+def score(key, node):
+    noise = jitter(key, node)
+    extra = jax.random.normal(key, (4,))  # reuse: jitter already drew
+    return noise + extra

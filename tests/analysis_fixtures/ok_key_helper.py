@@ -1,0 +1,23 @@
+"""OK: a derive-only helper may be called repeatedly on one key.
+
+``fan_out`` only ever *derives* from its key (random.split), so passing
+the same key to it twice correlates nothing — exactly like calling
+``jax.random.split`` twice.  The cross-function key-reuse pass
+(analysis/astlint.py summaries) classifies the helper as weight-0 from
+its body; the old intra-function-only rule counted each helper call as
+a draw and flagged this file as reuse.
+"""
+
+import jax
+
+
+def fan_out(key, n):
+    return jax.random.split(key, n)
+
+
+def stream_pairs(key):
+    first = fan_out(key, 2)
+    second = fan_out(key, 3)  # same key, derive-only helper: safe
+    a = jax.random.uniform(first[0], (4,))
+    b = jax.random.uniform(second[1], (4,))
+    return a + b

@@ -23,6 +23,10 @@ def _lint(name):
 
 RULE_FIXTURES = [
     ("bad_key_reuse.py", "ok_key_split.py", "key-reuse", 2),
+    # the cross-function pass: a drawing helper propagates its
+    # consumption to call sites; a derive-only helper stops counting as
+    # a draw (the ok file is reuse-FLAGGED under intra-only analysis)
+    ("bad_key_helper.py", "ok_key_helper.py", "key-reuse", 1),
     ("bad_traced_branch.py", "ok_lax_cond.py", "traced-branch", 2),
     ("bad_sync_loop.py", "ok_sync_outside.py", "sync-in-loop", 4),
     ("bad_f64.py", "ok_f32.py", "f64-dtype", 3),
@@ -80,6 +84,86 @@ def test_mesh_axis_clean_on_the_real_sharding_modules():
                         Report())
     assert not [d for d in report.diagnostics if d.rule == "mesh-axis"], \
         report.format_human()
+
+
+def test_key_reuse_summaries_cross_module(tmp_path):
+    """lint_paths' two-pass table: a derive-only helper in one module is
+    recognized at call sites in ANOTHER module (import-alias
+    resolution), and a drawing helper still counts as a draw there."""
+    from fastconsensus_tpu.analysis import Report, lint_paths
+
+    (tmp_path / "helpers.py").write_text(
+        "import jax\n\n\n"
+        "def fan(key, n):\n"
+        "    return jax.random.split(key, n)\n\n\n"
+        "def draw(key, shape):\n"
+        "    return jax.random.uniform(key, shape)\n")
+    (tmp_path / "ok_user.py").write_text(
+        "import helpers as h\n\n\n"
+        "def use(key):\n"
+        "    a = h.fan(key, 2)\n"
+        "    b = h.fan(key, 3)\n"       # derive-only helper: safe
+        "    return a, b\n")
+    (tmp_path / "bad_user.py").write_text(
+        "from helpers import draw\n\n\n"
+        "def use(key):\n"
+        "    x = draw(key, (2,))\n"
+        "    y = draw(key, (3,))\n"     # two draws on one key
+        "    return x, y\n")
+    report = lint_paths([str(tmp_path)], Report())
+    by_file = {}
+    for d in report.diagnostics:
+        by_file.setdefault(os.path.basename(d.file), []).append(d.rule)
+    assert "ok_user.py" not in by_file, by_file
+    assert by_file.get("bad_user.py") == ["key-reuse"], by_file
+
+
+def test_key_reuse_summaries_resolve_relative_imports(tmp_path):
+    """Relative imports anchor against the importing file's package
+    path, so `from .helpers import fan` resolves into the summary
+    table exactly like its absolute spelling."""
+    from fastconsensus_tpu.analysis import Report, lint_paths
+
+    pkg = tmp_path / "fastconsensus_tpu"
+    pkg.mkdir()
+    (pkg / "helpers.py").write_text(
+        "import jax\n\n\n"
+        "def fan(key, n):\n"
+        "    return jax.random.split(key, n)\n\n\n"
+        "def draw(key, shape):\n"
+        "    return jax.random.uniform(key, shape)\n")
+    (pkg / "ok_rel.py").write_text(
+        "from .helpers import fan\n\n\n"
+        "def use(key):\n"
+        "    return fan(key, 2), fan(key, 3)\n")   # derive-only: safe
+    (pkg / "bad_rel.py").write_text(
+        "from . import helpers as h\n\n\n"
+        "def use(key):\n"
+        "    x = h.draw(key, (2,))\n"
+        "    return x, h.draw(key, (3,))\n")       # two draws, one key
+    report = lint_paths([str(pkg)], Report())
+    by_file = {}
+    for d in report.diagnostics:
+        by_file.setdefault(os.path.basename(d.file), []).append(d.rule)
+    assert "ok_rel.py" not in by_file, by_file
+    assert by_file.get("bad_rel.py") == ["key-reuse"], by_file
+
+
+def test_key_reuse_helper_summaries_shapes():
+    """summarize_key_params classifies deriver/draw/reuse weights."""
+    from fastconsensus_tpu.analysis.astlint import summarize_key_params
+
+    table = summarize_key_params(
+        "import jax\n\n\n"
+        "def derive(key):\n"
+        "    return jax.random.fold_in(key, 1)\n\n\n"
+        "def one(key):\n"
+        "    return jax.random.bits(key, (2,), 'uint32')\n\n\n"
+        "def two(key):\n"
+        "    a = jax.random.uniform(key, (2,))\n"
+        "    return a + jax.random.normal(key, (2,))\n")
+    weights = {k: v["weights"]["key"] for k, v in table.items()}
+    assert weights == {"derive": 0, "one": 1, "two": 2}
 
 
 def test_pragma_suppresses_and_is_counted():

@@ -1,0 +1,422 @@
+"""fcpool: sticky bucket->device scheduling, worker failure isolation,
+the mesh-sharded huge tier, and the per-device observability surface —
+all under the suite's forced 8-device virtual CPU mesh (conftest.py),
+so every contract here runs in tier-1 without hardware."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def _ring(n, chords=0, shift=7):
+    idx = np.arange(n)
+    edges = [np.stack([idx, (idx + 1) % n], 1)]
+    if chords:
+        c = np.arange(chords)
+        edges.append(np.stack([c % n, (c + shift) % n], 1))
+    return np.concatenate(edges).astype(np.int64)
+
+
+def _spec(edges, n_nodes, **over):
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.jobs import JobSpec
+
+    kwargs = dict(algorithm="louvain", n_p=4, tau=0.2, delta=0.02,
+                  max_rounds=2, seed=0)
+    kwargs.update(over)
+    return JobSpec(edges=np.asarray(edges, dtype=np.int64),
+                   n_nodes=n_nodes, config=ConsensusConfig(**kwargs))
+
+
+def _wait(jobs, timeout=180.0):
+    deadline = time.monotonic() + timeout
+    for j in jobs:
+        while j.state not in ("done", "failed"):
+            assert time.monotonic() < deadline, j.describe()
+            time.sleep(0.02)
+
+
+# -- scheduler (unit, jax-free stubs) ----------------------------------
+
+
+class _StubWorker:
+    def __init__(self, idx, load=0, warm=(), cordoned=False):
+        self.idx = idx
+        self._load = load
+        self.warm_buckets = set(warm)
+        self.cordoned = cordoned
+
+    def eligible(self, exclude=frozenset()):
+        return not self.cordoned and self.idx not in exclude
+
+    def load(self):
+        return self._load
+
+
+def test_scheduler_sticky_home_and_spill():
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.scheduler import StickyScheduler
+
+    base = obs_counters.get_registry().counters()
+    sched = StickyScheduler(spill_backlog=2)
+    ws = [_StubWorker(0), _StubWorker(1), _StubWorker(2)]
+    # first route mints the home on the least-loaded worker...
+    assert sched.route("b1", ws).idx == 0
+    assert sched.affinity() == {"b1": 0}
+    # ...and stays sticky while the home's backlog is at the threshold
+    ws[0]._load = 2
+    assert sched.route("b1", ws).idx == 0
+    # past the threshold it spills to the least-loaded other worker
+    ws[0]._load = 3
+    ws[1]._load = 1
+    assert sched.route("b1", ws).idx == 2
+    # the home does NOT move on a spill
+    assert sched.affinity() == {"b1": 0}
+    ws[0]._load = 0
+    assert sched.route("b1", ws).idx == 0
+    since = obs_counters.get_registry().counters_since(base)
+    assert since.get("serve.sched.assigns", 0) == 1
+    assert since.get("serve.sched.sticky_hits", 0) == 2
+    assert since.get("serve.sched.spills", 0) == 1
+
+
+def test_scheduler_spill_prefers_warm_workers():
+    from fastconsensus_tpu.serve.scheduler import StickyScheduler
+
+    sched = StickyScheduler(spill_backlog=0)
+    ws = [_StubWorker(0, load=5), _StubWorker(1, load=3),
+          _StubWorker(2, load=4, warm=("b1",))]
+    sched.route("b1", [ws[0]])          # home = 0
+    # worker 1 is less loaded, but worker 2 already holds b1's
+    # executables — spilling there compiles nothing
+    assert sched.route("b1", ws).idx == 2
+
+
+def test_scheduler_cordon_exclusion_and_rehome():
+    from fastconsensus_tpu.serve.scheduler import (NoEligibleWorker,
+                                                   StickyScheduler)
+
+    sched = StickyScheduler()
+    ws = [_StubWorker(0), _StubWorker(1)]
+    assert sched.route("b1", ws).idx == 0
+    # excluded-for-this-job routing never lands on the excluded device
+    assert sched.route("b1", ws, exclude=frozenset({0})).idx == 1
+    # a cordoned home re-homes the bucket
+    ws[0].cordoned = True
+    assert sched.route("b1", ws).idx == 1
+    assert sched.affinity() == {"b1": 1}
+    ws[1].cordoned = True
+    with pytest.raises(NoEligibleWorker):
+        sched.route("b1", ws)
+
+
+# -- sticky affinity through the real pool -----------------------------
+
+
+def test_same_bucket_burst_lands_on_one_device_zero_foreign_compiles():
+    """ISSUE 6 acceptance: a same-bucket burst routes to ONE sticky
+    device; every other worker compiles nothing (executables are
+    per-device, so any foreign compile means routing leaked)."""
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(queue_depth=32, pin_sizing=False,
+                                       devices=4)).start()
+    base = obs_counters.get_registry().counters()
+    try:
+        jobs = [svc.submit(_spec(_ring(40, chords=40), 40, seed=s))
+                for s in range(1, 5)]
+        _wait(jobs)
+        assert all(j.state == "done" for j in jobs), \
+            [j.error for j in jobs]
+        homes = {j.device for j in jobs}
+        assert len(homes) == 1, [j.describe() for j in jobs]
+        home = homes.pop()
+        since = obs_counters.get_registry().counters_since(base)
+        for w in svc.pool.chip_workers:
+            if w.idx != home:
+                assert since.get(
+                    f"serve.device.{w.idx}.xla_compiles", 0) == 0, since
+        assert svc.stats()["affinity"] == {"n64_e96": home}
+    finally:
+        assert svc.drain(60)
+
+
+def test_worker_death_requeues_with_exclusion_and_cordons():
+    """A worker that dies mid-batch: its job completes on another
+    device, the dead device is cordoned in /healthz, and the job
+    carries the exclusion + requeue metadata."""
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(queue_depth=8, pin_sizing=False,
+                                       devices=2)).start()
+    base = obs_counters.get_registry().counters()
+    w0 = svc.pool.chip_workers[0]
+
+    def boom(batch):
+        raise RuntimeError("injected infrastructure failure")
+
+    w0._run = boom
+    try:
+        job = svc.submit(_spec(_ring(12, chords=6), 12, seed=3))
+        _wait([job])
+        assert job.state == "done", job.error
+        assert job.device == 1
+        assert job.excluded() == frozenset({0})
+        assert job.describe()["requeues"] == 1
+        stats = svc.stats()
+        assert stats["cordoned_devices"] == [0]
+        dead = next(w for w in stats["workers"] if w["device"] == 0)
+        assert dead["cordoned"] and "injected" in dead["error"]
+        since = obs_counters.get_registry().counters_since(base)
+        assert since.get("serve.pool.worker_deaths", 0) == 1
+        assert since.get("serve.device.0.deaths", 0) == 1
+        assert since.get("serve.pool.requeued_jobs", 0) == 1
+    finally:
+        assert svc.drain(60)
+
+
+def test_job_that_cordons_every_device_fails_as_itself():
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(queue_depth=8, pin_sizing=False,
+                                       devices=1)).start()
+
+    def boom(batch):
+        raise RuntimeError("dies everywhere")
+
+    svc.pool.chip_workers[0]._run = boom
+    try:
+        job = svc.submit(_spec(_ring(12, chords=6), 12, seed=4))
+        _wait([job])
+        assert job.state == "failed"
+        assert "no eligible worker" in job.error
+    finally:
+        svc.drain(30)   # the lone worker is dead; queue still closes
+
+
+def test_backpressure_counts_worker_backlogs():
+    """The 429 contract survives the pool: the dispatcher eagerly moves
+    admitted jobs into per-worker deques, and those parked jobs must
+    still count against the queue's depth bound — otherwise a depth-1
+    queue would absorb an unbounded burst into worker backlogs."""
+    from fastconsensus_tpu.serve.queue import QueueFull
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(queue_depth=1, pin_sizing=False,
+                                       devices=2)).start()
+    entered, release = threading.Event(), threading.Event()
+    for w in svc.pool.chip_workers:
+        orig = w._run
+
+        def slow(batch, _orig=orig):
+            entered.set()
+            release.wait()
+            _orig(batch)
+
+        w._run = slow
+    try:
+        j1 = svc.submit(_spec(_ring(40, chords=40), 40, seed=11))
+        assert entered.wait(60), "worker never picked up the first job"
+        j2 = svc.submit(_spec(_ring(40, chords=40), 40, seed=12))
+        deadline = time.monotonic() + 30
+        while svc.pool.backlog() < 1:   # dispatch is asynchronous
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        with pytest.raises(QueueFull):
+            svc.submit(_spec(_ring(40, chords=40), 40, seed=13))
+    finally:
+        release.set()
+        _wait([j1, j2])
+        assert svc.drain(60)
+    assert j1.state == "done" and j2.state == "done", (j1.error, j2.error)
+
+
+def test_busy_worker_recoalesces_deque_burst_into_one_batch():
+    """Stall-then-burst through the pool: while the sticky worker is
+    busy, the eager dispatcher parks a same-group burst as single-job
+    deque batches — the worker must re-merge them into ONE batched
+    device call (serve.pool.deque_coalesced), or PR 5's coalescing
+    would only survive a deep admission heap."""
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(queue_depth=16, pin_sizing=False,
+                                       devices=1, max_batch=4)).start()
+    w = svc.pool.chip_workers[0]
+    entered, release = threading.Event(), threading.Event()
+    orig = w._run
+
+    def slow(batch):
+        entered.set()
+        release.wait()
+        orig(batch)
+
+    w._run = slow
+    base = obs_counters.get_registry().counters()
+    try:
+        # the stall runs a DIFFERENT batch group (n_p=8), so it can
+        # never merge with the burst behind it
+        stall = svc.submit(_spec(_ring(40, chords=40), 40, n_p=8,
+                                 seed=90))
+        assert entered.wait(60), "worker never picked up the stall job"
+        burst = [svc.submit(_spec(_ring(40, chords=40), 40, seed=s))
+                 for s in (91, 92, 93, 94)]
+        deadline = time.monotonic() + 30
+        while svc.pool.backlog() < 4:   # dispatch is asynchronous
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+    finally:
+        release.set()
+    try:
+        _wait([stall] + burst)
+        assert all(j.state == "done" for j in [stall] + burst), \
+            [j.error for j in [stall] + burst]
+        assert stall.batch_size == 1
+        batch_ids = {j.batch_id for j in burst}
+        assert len(batch_ids) == 1 and None not in batch_ids, \
+            [j.describe() for j in burst]
+        assert all(j.batch_size == 4 for j in burst)
+        since = obs_counters.get_registry().counters_since(base)
+        assert since.get("serve.pool.deque_coalesced", 0) == 3, since
+    finally:
+        assert svc.drain(120)
+
+
+# -- the huge tier -----------------------------------------------------
+
+
+def test_huge_bucket_routes_to_mesh_and_matches_solo_bitwise():
+    """ISSUE 6 acceptance: a graph past the single-chip bucket ceiling
+    runs edge-sharded on the reserved mesh group, with partitions
+    bit-identical to the solo (unsharded) reference at the same seed.
+    closure_sampler pinned to "scatter" on both sides — the sharded
+    tail requires the sort-free engine (test_parallel.py parity)."""
+    from fastconsensus_tpu.consensus import run_consensus
+    from fastconsensus_tpu.models.registry import get_detector
+    from fastconsensus_tpu.serve import bucketer
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    edges = _ring(100, chords=60)   # 160 canonical edges -> n128_e192
+    spec = _spec(edges, 100, seed=5, closure_sampler="scatter")
+    assert spec.bucket().key() == "n128_e192"
+    svc = ConsensusService(ServeConfig(
+        queue_depth=8, pin_sizing=False, devices=4, huge_devices=2,
+        chip_max_edges=96)).start()
+    try:
+        job = svc.submit(spec)
+        _wait([job])
+        assert job.state == "done", job.error
+        assert job.result["tier"] == "mesh"
+        mesh_worker = svc.pool.mesh_workers[0]
+        assert job.device == mesh_worker.idx
+        assert len(mesh_worker.devices) == 2
+        wstats = [w for w in svc.stats()["workers"]
+                  if w["kind"] == "mesh"]
+        assert wstats and wstats[0]["buckets"] == {"n128_e192": 1}
+    finally:
+        assert svc.drain(120)
+    slab, bucket = bucketer.pad_to_bucket(edges, 100)
+    ref = run_consensus(slab, get_detector("louvain"), spec.config,
+                        n_closure=bucket.n_closure)
+    for served, raw in zip(job.result["partitions"], ref.partitions):
+        lab = np.asarray(raw)[:100]
+        _, compact = np.unique(lab, return_inverse=True)
+        np.testing.assert_array_equal(served, compact.astype(np.int32))
+
+
+def test_chip_ceiling_requires_huge_tier():
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(pin_sizing=False,
+                                       chip_max_edges=96))
+    with pytest.raises(ValueError, match="huge"):
+        svc.start()
+    # ...and the mirror: a huge tier with no ceiling is unreachable
+    svc = ConsensusService(ServeConfig(pin_sizing=False,
+                                       huge_devices=2))
+    with pytest.raises(ValueError, match="chip_max_edges"):
+        svc.start()
+
+
+# -- per-device observability ------------------------------------------
+
+
+def test_healthz_workers_and_device_metrics_over_http():
+    """The typed client view of /healthz worker state and the /metricsz
+    per-device breakdown (jobs, compiles, busy-fraction)."""
+    from fastconsensus_tpu.serve.client import ServeClient, WorkerState
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig,
+                                                make_http_server)
+
+    svc = ConsensusService(ServeConfig(queue_depth=8, pin_sizing=False,
+                                       devices=2)).start()
+    httpd = make_http_server(svc, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout=30.0)
+    try:
+        res = client.run(_ring(40, chords=40).tolist(), n_nodes=40,
+                         n_p=4, max_rounds=2, seed=21, timeout=180)
+        assert res["device"] is not None
+        workers = client.workers()
+        assert len(workers) == 2
+        assert all(isinstance(w, WorkerState) for w in workers)
+        assert {w.device for w in workers} == {0, 1}
+        ran = next(w for w in workers if w.device == res["device"])
+        assert ran.jobs >= 1 and ran.buckets.get("n64_e96") >= 1
+        assert not ran.cordoned and ran.alive
+        devs = client.device_metrics()
+        assert set(devs) == {"0", "1"}
+        hot = devs[str(res["device"])]
+        assert hot["jobs"] >= 1
+        assert hot["xla_compiles"] > 0
+        assert 0.0 <= hot["busy_frac"] <= 1.0
+        cold = devs[str(1 - res["device"])]
+        # jobs/busy are service-scoped: the idle worker shows zero
+        # (compile counters are process-scoped, so earlier tests in
+        # this pytest process may have charged this device ordinal)
+        assert cold["jobs"] == 0 and cold["busy_s"] == 0.0
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        assert svc.drain(60)
+
+
+def test_drain_trace_has_per_device_tracks(tmp_path):
+    """One merged drain-time trace with named per-device thread tracks
+    (obs/export.py thread_names) and device-tagged spans."""
+    import json
+
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(
+        queue_depth=8, pin_sizing=False, devices=2,
+        trace_dir=str(tmp_path))).start()
+    try:
+        jobs = [svc.submit(_spec(_ring(40, chords=40), 40, seed=s))
+                for s in (31, 32)]
+        _wait(jobs)
+        assert all(j.state == "done" for j in jobs)
+    finally:
+        assert svc.drain(60)
+    blob = json.load(open(tmp_path / "fcserve_trace.json"))
+    names = [e["args"]["name"] for e in blob["traceEvents"]
+             if e.get("name") == "thread_name"]
+    assert any(n.startswith("device-") for n in names), names
+    tagged = [e for e in blob["traceEvents"]
+              if e.get("cat") == "fcobs"
+              and e.get("args", {}).get("device") is not None]
+    assert tagged, "no device-tagged spans in the drain trace"
