@@ -1,19 +1,26 @@
-"""fcheck: the project's static-analysis suite (AST lint + jaxpr audit +
-recompile guard).
+"""fcheck: the project's static-analysis suite (AST lint + concurrency
+pass + jaxpr audit + runtime guards).
 
-Three layers, one report (run ``python -m fastconsensus_tpu.analysis``):
+Four layers, one report (run ``python -m fastconsensus_tpu.analysis``):
 
 1. **AST lint** (analysis/astlint.py) — project-specific source rules:
    PRNG key reuse, Python control flow on traced values, retrace
    hazards, weak static args, float64 drift, host syncs in hot loops,
-   Pallas kernels closing over tracers.
-2. **jaxpr audit** (analysis/jaxpr_audit.py) — traces every registered
+   Pallas kernels closing over tracers, mesh-axis typos.
+2. **Concurrency pass** (analysis/concurrency.py) — whole-program race
+   & lock-discipline rules over the multi-threaded serving stack:
+   guarded-field, lock-order (cycle = potential deadlock),
+   blocking-under-lock, notify-outside-lock, unguarded-root-write.
+3. **jaxpr audit** (analysis/jaxpr_audit.py) — traces every registered
    jitted entry point (analysis/entrypoints.py) at canonical shapes and
    walks the staged program for forbidden primitives (f64 casts,
    embedded device_put, ungated huge gathers).
-3. **recompile guard** (analysis/recompile_guard.py) — a runtime context
-   manager bounding XLA compilations over a region; the tier-1 test
-   pins the 2-round consensus compile budget with it.
+4. **Runtime guards** — :class:`CompileGuard`
+   (analysis/recompile_guard.py) bounds XLA compilations over a region
+   (the tier-1 compile-budget pins), and the opt-in lock-order recorder
+   (analysis/lockorder.py, ``FCTPU_LOCK_ORDER=1``) logs the observed
+   lock acquisition digraph so the pool stress test can assert it stays
+   acyclic and consistent with layer 2's static graph.
 
 CI gates on a clean run (scripts/ci_check.sh); deliberate violations
 carry ``# fcheck: ok=<rule>`` pragmas with reasons
@@ -47,16 +54,21 @@ def lint_paths(paths, report=None):
     """Lint every ``.py`` under ``paths`` (files or directories) into a
     Report (created if not given).
 
-    Two passes: the first summarizes every function's PRNG-key
+    Three passes: the first summarizes every function's PRNG-key
     consumption (astlint.summarize_key_params), the second lints with
     that table in hand — so the ``key-reuse`` rule tracks keys through
     helper calls across module boundaries (e.g. ``seg.pair_jitter``)
-    instead of treating every callee as an opaque single draw.
+    instead of treating every callee as an opaque single draw — and the
+    third runs the whole-program concurrency analysis
+    (analysis/concurrency.py: guarded-field, lock-order,
+    blocking-under-lock, notify-outside-lock, unguarded-root-write)
+    over the same source set.
     """
     import os
 
     from fastconsensus_tpu.analysis.astlint import (lint_source,
                                                     summarize_key_params)
+    from fastconsensus_tpu.analysis.concurrency import check_concurrency
 
     if report is None:
         report = Report()
@@ -88,4 +100,7 @@ def lint_paths(paths, report=None):
         report.extend(diags)
         report.n_suppressed += suppressed
         report.n_files += 1
+    conc_diags, conc_suppressed = check_concurrency(sources)
+    report.extend(conc_diags)
+    report.n_suppressed += conc_suppressed
     return report

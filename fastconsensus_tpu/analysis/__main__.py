@@ -45,6 +45,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--entry-point", action="append", default=None,
                         metavar="NAME",
                         help="audit only these entry points (repeatable)")
+    parser.add_argument("--only", default=None, metavar="RULE[,RULE...]",
+                        help="keep only these rule ids in the report "
+                             "(comma-separated, e.g. "
+                             "'lock-order,guarded-field'); the jaxpr "
+                             "audit is skipped unless a jaxpr-* rule "
+                             "is selected — lets a developer iterate "
+                             "on one rule and CI archive per-rule "
+                             "reports")
     parser.add_argument("--gather-threshold", type=int, default=1 << 26,
                         help="jaxpr audit: max elements one gather may "
                              "materialize (default 2^26)")
@@ -64,9 +72,30 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
 
+    only = None
+    if args.only:
+        from fastconsensus_tpu.analysis.astlint import ASTLINT_RULES
+        from fastconsensus_tpu.analysis.concurrency import \
+            CONCURRENCY_RULES
+
+        known = set(ASTLINT_RULES) | set(CONCURRENCY_RULES) | {
+            "jaxpr-f64", "jaxpr-device-put", "jaxpr-gather-size",
+            "trace-error"}
+        only = {r.strip() for r in args.only.split(",") if r.strip()}
+        unknown = only - known
+        if unknown:
+            # a typo'd --only would make the gate vacuously green
+            print(f"fcheck: unknown rule id(s) in --only: "
+                  f"{', '.join(sorted(unknown))}; known rules: "
+                  f"{', '.join(sorted(known))}", file=sys.stderr)
+            return 2
+
     run_jaxpr = args.jaxpr
     if run_jaxpr is None:
         run_jaxpr = _inside_package(paths)
+    if run_jaxpr and only is not None and \
+            not any(r.startswith("jaxpr") for r in only):
+        run_jaxpr = False  # no jaxpr rule selected: skip the jax import
     if run_jaxpr:
         try:
             from fastconsensus_tpu.analysis.jaxpr_audit import \
@@ -81,6 +110,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"fcheck: jaxpr audit failed to run: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             return 2
+
+    if only is not None:
+        report.diagnostics = [d for d in report.diagnostics
+                              if d.rule in only]
 
     if args.json:
         os.makedirs(os.path.dirname(os.path.abspath(args.json)),

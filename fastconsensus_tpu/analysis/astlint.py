@@ -115,6 +115,14 @@ _TRACED_PREDICATES = {
     "greater", "less", "greater_equal", "less_equal", "where", "argmax",
     "argmin",
 }
+# The stable rule-id universe this linter can emit (CLI --only
+# validation; concurrency.CONCURRENCY_RULES and the jaxpr audit's
+# jaxpr-*/trace-error ids are the other families).
+ASTLINT_RULES = (
+    "key-reuse", "traced-branch", "retrace-risk", "weak-static-arg",
+    "f64-dtype", "sync-in-loop", "kernel-tracer-closure",
+    "module-jnp-const", "mesh-axis", "syntax-error",
+)
 _SYNC_CALLS_ATTR = {"item", "block_until_ready"}
 _F64_NAMES = {"float64", "double", "complex128"}
 # lax collectives whose axis argument is a mesh axis NAME; mapped to the

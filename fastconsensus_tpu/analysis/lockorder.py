@@ -1,0 +1,299 @@
+"""Runtime lock-order recorder: the dynamic half of fcheck-concurrency.
+
+The static ``lock-order`` rule (analysis/concurrency.py) cannot see
+through stored callables — ``AdmissionQueue._extra_depth`` is a lambda
+installed at runtime that reaches ``_Worker._cond`` from under the
+queue's own condition, an edge no AST walk can attribute.  This module
+records the acquisition digraph actually *observed* while the code
+runs, so the test suite can assert that the union of the static and the
+observed graphs stays acyclic — the tripwire that keeps the static
+model honest.
+
+Opt-in only (``FCTPU_LOCK_ORDER=1``, wired in tests/conftest.py, or an
+explicit :func:`recording` block): :func:`install` replaces
+``threading.Lock`` / ``RLock`` / ``Condition`` with recording wrappers
+**for locks created from inside the fastconsensus_tpu tree** — stdlib
+and third-party lock construction (including the RLock a bare
+``Condition()`` builds internally, whose creating frame is
+threading.py) passes through untouched.  Each wrapped lock remembers
+its *creation site* (``file:line`` — which for the ``self._lock =
+threading.Lock()`` idiom is the declaration the static pass keys on,
+see ``concurrency.lock_sites``), and every acquisition while other
+recorded locks are held appends the edge (held site -> acquired site)
+to the active :class:`LockOrderRecorder`.
+
+``Condition`` is wrapped by handing the real ``threading.Condition`` a
+recording Lock: ``wait()`` then releases and re-acquires through the
+wrapper, so the held-stack is correct across waits (a thread parked in
+``wait`` holds nothing; edges re-record on wake-up).
+
+Overhead is a thread-local list append per acquisition — irrelevant for
+tests, which is the only place this runs.  Production never imports it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from fastconsensus_tpu.analysis.concurrency import find_cycle
+
+_REAL = {
+    "Lock": threading.Lock,
+    "RLock": threading.RLock,
+    "Condition": threading.Condition,
+}
+
+_recorder: Optional["LockOrderRecorder"] = None
+_installed = False
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class LockOrderRecorder:
+    """Accumulates observed acquisition edges between lock creation
+    sites ((abspath, lineno) pairs)."""
+
+    def __init__(self) -> None:
+        self._lock = _REAL["Lock"]()
+        self._edges: Dict[Tuple[Tuple[str, int], Tuple[str, int]],
+                          int] = {}
+        self._local = threading.local()
+
+    def _held(self) -> List[Tuple[Tuple[str, int], int]]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def note_acquire(self, site: Tuple[str, int], lid: int) -> None:
+        stack = self._held()
+        if stack:
+            with self._lock:
+                for held_site, held_lid in stack:
+                    if held_lid == lid:
+                        # re-entrant RLock acquisition of the SAME
+                        # instance: not an ordering edge (a same-SITE
+                        # edge between DISTINCT instances is — that is
+                        # the two-workers-in-opposite-orders hazard)
+                        continue
+                    key = (held_site, site)
+                    self._edges[key] = self._edges.get(key, 0) + 1
+        stack.append((site, lid))
+
+    def note_release(self, site: Tuple[str, int], lid: int) -> None:
+        stack = self._held()
+        # release order may not be LIFO (rare but legal): drop the
+        # most recent matching entry
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == (site, lid):
+                del stack[i]
+                return
+
+    def edges(self) -> Set[Tuple[Tuple[str, int], Tuple[str, int]]]:
+        with self._lock:
+            return set(self._edges)
+
+    def edge_counts(self) -> Dict[Tuple[Tuple[str, int],
+                                        Tuple[str, int]], int]:
+        with self._lock:
+            return dict(self._edges)
+
+    def named_edges(self, sites: Dict[Tuple[str, int], str]
+                    ) -> Set[Tuple[str, str]]:
+        """Observed edges mapped onto the static pass's lock keys
+        (``concurrency.lock_sites``); sites the static pass does not
+        know keep their ``file:line`` spelling so nothing is silently
+        dropped."""
+        def name(site: Tuple[str, int]) -> str:
+            return sites.get(site, f"{site[0]}:{site[1]}")
+
+        return {(name(a), name(b)) for a, b in self.edges()}
+
+    def assert_acyclic(self, extra_edges: Optional[
+            Set[Tuple[str, str]]] = None,
+            sites: Optional[Dict[Tuple[str, int], str]] = None) -> None:
+        """Raise AssertionError when the observed digraph — unioned
+        with ``extra_edges`` (canonically the static graph) — has a
+        cycle.  This is THE consistency contract between the two
+        halves: every ordering the runtime exhibits must compose with
+        every ordering the static pass proved, or a deadlock is one
+        unlucky interleaving away."""
+        edges = self.named_edges(sites or {})
+        if extra_edges:
+            edges = edges | set(extra_edges)
+        cyc = find_cycle(edges)
+        if cyc is not None:
+            raise AssertionError(
+                "observed lock-order cycle (union with static graph): "
+                + " -> ".join(cyc + [cyc[0]]))
+
+
+class _TracedLock:
+    """Records acquisitions of one underlying lock against the active
+    recorder.  Duck-types the full Lock protocol; ``threading.
+    Condition`` drives it through acquire/release, so waits release the
+    held-stack entry and re-add it on wake-up."""
+
+    def __init__(self, inner, site: Tuple[str, int]) -> None:
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and _recorder is not None:
+            _recorder.note_acquire(self._site, id(self))
+        return ok
+
+    def release(self) -> None:
+        if _recorder is not None:
+            _recorder.note_release(self._site, id(self))
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    # Condition protocol: threading.Condition binds these when present.
+    # Delegating keeps wait() correct for a re-entrant inner lock (the
+    # plain-Lock fallbacks Condition would use otherwise misdetect
+    # ownership of a held RLock) while the recorder's held-stack still
+    # drops the entry across the wait and re-adds it on wake-up.
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        if _recorder is not None:
+            _recorder.note_release(self._site, id(self))
+        if hasattr(self._inner, "_release_save"):
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        if _recorder is not None:
+            _recorder.note_acquire(self._site, id(self))
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<TracedLock {self._site[0]}:{self._site[1]} " \
+               f"{self._inner!r}>"
+
+
+def _creation_site() -> Optional[Tuple[str, int]]:
+    """(abspath, lineno) of the first stack frame outside this module
+    and threading.py — None when the construction did not come from the
+    fastconsensus_tpu tree (those locks stay unwrapped)."""
+    f = sys._getframe(2)
+    this = os.path.abspath(__file__)
+    while f is not None:
+        fname = os.path.abspath(f.f_code.co_filename)
+        if fname != this and not fname.endswith(
+                os.sep + "threading.py"):
+            if fname.startswith(_PKG_DIR + os.sep):
+                return (fname, f.f_lineno)
+            return None
+        f = f.f_back
+    return None
+
+
+def _make_lock() -> object:
+    site = _creation_site()
+    inner = _REAL["Lock"]()
+    if site is None:
+        return inner
+    return _TracedLock(inner, site)
+
+
+def _make_rlock() -> object:
+    site = _creation_site()
+    inner = _REAL["RLock"]()
+    if site is None:
+        return inner
+    return _TracedLock(inner, site)
+
+
+def _make_condition(lock=None) -> object:
+    site = _creation_site()
+    if site is None:
+        return _REAL["Condition"](lock)
+    if lock is None:
+        # the condition's internal lock IS the recorded lock: every
+        # with-block, notify and wait goes through the wrapper
+        lock = _TracedLock(_REAL["RLock"](), site)
+    return _REAL["Condition"](lock)
+
+
+def install(recorder: Optional[LockOrderRecorder] = None
+            ) -> LockOrderRecorder:
+    """Patch ``threading.Lock/RLock/Condition`` so locks created from
+    package code record into ``recorder`` (a fresh one by default).
+    Idempotent: calling again swaps the active recorder only."""
+    global _recorder, _installed
+    if recorder is None:
+        recorder = LockOrderRecorder()
+    _recorder = recorder
+    if not _installed:
+        threading.Lock = _make_lock          # type: ignore[misc]
+        threading.RLock = _make_rlock        # type: ignore[misc]
+        threading.Condition = _make_condition  # type: ignore[misc]
+        _installed = True
+    return recorder
+
+
+def uninstall() -> None:
+    """Restore the real factories.  Locks already wrapped keep working
+    (they hold real locks inside) but stop recording."""
+    global _recorder, _installed
+    _recorder = None
+    if _installed:
+        threading.Lock = _REAL["Lock"]        # type: ignore[misc]
+        threading.RLock = _REAL["RLock"]      # type: ignore[misc]
+        threading.Condition = _REAL["Condition"]  # type: ignore[misc]
+        _installed = False
+
+
+def maybe_install_from_env() -> Optional[LockOrderRecorder]:
+    """Install iff ``FCTPU_LOCK_ORDER=1`` (the test-suite hook)."""
+    if os.environ.get("FCTPU_LOCK_ORDER") == "1":
+        return install()
+    return None
+
+
+class recording:
+    """``with lockorder.recording() as rec:`` — scoped install/swap.
+
+    If the factories are already patched (env-var install), only the
+    active recorder is swapped and restored; otherwise the factories
+    are patched for the block and unpatched after."""
+
+    def __enter__(self) -> LockOrderRecorder:
+        global _recorder
+        self._was_installed = _installed
+        self._prev = _recorder
+        self._rec = LockOrderRecorder()
+        install(self._rec)
+        return self._rec
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        global _recorder
+        if self._was_installed:
+            _recorder = self._prev
+        else:
+            uninstall()

@@ -101,6 +101,10 @@ def _get_lib() -> ctypes.CDLL:
         if _lib is None:
             if _build_error is not None:
                 raise ImportError(f"native build failed: {_build_error}")
+            # fcheck: ok=blocking-under-lock (the lock EXISTS to
+            # serialize the one-time compiler run — concurrent first
+            # callers must block until the single build lands; after
+            # that the cached handle returns without ever blocking)
             _lib = _build()
             if _lib is None:
                 raise ImportError(f"native build failed: {_build_error}")

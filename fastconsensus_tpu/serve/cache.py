@@ -58,6 +58,7 @@ class ResultCache:
         operator reads off ``/metricsz``.
         """
         now = self._clock()
+        ttl = self.ttl_seconds   # immutable after init; read unlocked
         with self._lock:
             entry = self._entries.get(key)
             if entry is None:
@@ -65,7 +66,7 @@ class ResultCache:
                     self._reg.inc("serve.cache.miss")
                 return None
             stored_at, value = entry
-            if now - stored_at > self.ttl_seconds:
+            if now - stored_at > ttl:
                 del self._entries[key]
                 self._reg.inc("serve.cache.expired")
                 if count_miss:

@@ -99,9 +99,14 @@ class _Worker:
         return not self._started or self._thread.is_alive()
 
     def eligible(self, exclude: FrozenSet[int] = frozenset()) -> bool:
-        return (not self.cordoned and self.alive()
-                and not self._closed_and_idle()
-                and self.idx not in exclude)
+        if self.idx in exclude or not self.alive():
+            return False
+        with self._cond:
+            if self.cordoned:
+                return False
+            # a closed worker still drains its backlog, but routing new
+            # work at one about to exit would strand the jobs
+            return not (self._closed and not self._batches)
 
     def load(self) -> int:
         """Queued jobs + unfinished pre-warm specs (routing weight)."""
@@ -114,12 +119,6 @@ class _Worker:
         slot)."""
         with self._cond:
             return sum(len(b) for b in self._batches)
-
-    def _closed_and_idle(self) -> bool:
-        # a closed worker still drains its backlog, but routing new work
-        # at one that is about to exit would strand the jobs
-        with self._cond:
-            return self._closed and not self._batches
 
     # -- dispatcher interface ---------------------------------------
 
@@ -144,11 +143,32 @@ class _Worker:
 
     def note_job(self, bucket: str) -> None:
         """Residency bookkeeping (``bucket`` is the bucket key string),
-        called by the service per finished job (thread-confined to this
-        worker's thread)."""
-        self.jobs_done += 1
-        self.warm_buckets.add(bucket)
-        self.buckets[bucket] = self.buckets.get(bucket, 0) + 1
+        called by the service per finished job.  Guarded: ``describe``
+        snapshots these maps from /healthz handler threads, and a dict
+        iterated while this thread inserts raises RuntimeError."""
+        with self._cond:
+            self.jobs_done += 1
+            self.warm_buckets.add(bucket)
+            self.buckets[bucket] = self.buckets.get(bucket, 0) + 1
+
+    def note_warm(self, bucket: str) -> None:
+        """Mark a bucket's executables resident (pre-warm path)."""
+        with self._cond:
+            self.warm_buckets.add(bucket)
+
+    def is_warm(self, bucket: str) -> bool:
+        """Whether this worker already holds the bucket's executables
+        (the scheduler's warm-preference probe — a locked accessor, so
+        routing threads never read the set mid-mutation)."""
+        with self._cond:
+            return bucket in self.warm_buckets
+
+    def add_prewarm(self, spec: str) -> None:
+        """Queue one pre-warm spec (called before ``start()``, but
+        locked anyway: the field is read by the worker thread)."""
+        with self._cond:
+            self.prewarm_specs.append(spec)
+            self.prewarm_left += 1
 
     # -- the worker loop --------------------------------------------
 
@@ -184,7 +204,11 @@ class _Worker:
         if any(j.spec.batch_group() != group for j in batch[1:]):
             return  # a mixed batch never merges (and never packs)
         merged = 0
+        # fcheck: ok=guarded-field (the caller — _next — holds
+        # self._cond across this whole merge; the lock is a documented
+        # precondition of _coalesce, not re-taken to stay re-entrant)
         while self._batches and len(batch) < max_b:
+            # fcheck: ok=guarded-field (same caller-held _cond contract)
             nxt = self._batches[0]
             if len(batch) + len(nxt) > max_b or \
                     any(j.spec.batch_group() != group for j in nxt):
@@ -197,12 +221,16 @@ class _Worker:
     def _loop(self) -> None:
         from fastconsensus_tpu.analysis import CompileGuard
 
-        self.tid = threading.get_ident()
+        tid = threading.get_ident()
+        with self._cond:
+            # published for thread_names() (drain-time track naming),
+            # which reads from the main thread
+            self.tid = tid
         batch: Optional[List[Job]] = None
         guard = CompileGuard(
             registry=self._reg,
             counter=f"serve.device.{self.idx}.xla_compiles",
-            thread_ident=self.tid)
+            thread_ident=tid)
         try:
             with self._device_scope(), guard:
                 self._prewarm()
@@ -218,11 +246,15 @@ class _Worker:
             # keep the pool serving
             self._die(e, batch)
         finally:
+            with self._cond:
+                busy = self.busy_s
             self._reg.gauge(f"serve.device.{self.idx}.busy_s",
-                            round(self.busy_s, 6))
+                            round(busy, 6))
 
     def _prewarm(self) -> None:
-        for spec in self.prewarm_specs:
+        with self._cond:
+            specs = list(self.prewarm_specs)
+        for spec in specs:
             try:
                 self.service._prewarm_one(spec, worker=self)
             except Exception as e:  # noqa: BLE001 — a bad warm spec
@@ -239,14 +271,17 @@ class _Worker:
         try:
             self.service._drain_group(deque(batch), worker=self)
         finally:
-            self.busy_s += time.perf_counter() - t0
-            self.batches_done += 1
+            with self._cond:
+                self.busy_s += time.perf_counter() - t0
+                self.batches_done += 1
+                busy = self.busy_s
             self._reg.gauge(f"serve.device.{self.idx}.busy_s",
-                            round(self.busy_s, 6))
+                            round(busy, 6))
 
     def _die(self, exc: Exception, batch: Optional[List[Job]]) -> None:
-        self.cordoned = True
-        self.error = f"{type(exc).__name__}: {exc}"
+        with self._cond:
+            self.cordoned = True
+            self.error = f"{type(exc).__name__}: {exc}"
         self._reg.inc("serve.pool.worker_deaths")
         self._reg.inc(f"serve.device.{self.idx}.deaths")
         _logger.exception(
@@ -266,23 +301,26 @@ class _Worker:
             self.pool.requeue(requeue)
 
     def describe(self) -> dict:
+        # one atomic snapshot: /healthz handler threads call this while
+        # the worker mutates the residency maps — iterating them
+        # unlocked is the "dictionary changed size" crash class the
+        # concurrency lint exists to catch
+        alive = self.alive()
         with self._cond:
-            backlog = sum(len(b) for b in self._batches)
-            prewarm_left = self.prewarm_left
-        return {
-            "device": self.idx,
-            "kind": self.kind,
-            "alive": self.alive(),
-            "cordoned": self.cordoned,
-            "error": self.error,
-            "backlog": backlog,
-            "jobs": self.jobs_done,
-            "batches": self.batches_done,
-            "busy_s": round(self.busy_s, 3),
-            "buckets": dict(self.buckets),
-            "warm": sorted(self.warm_buckets),
-            "prewarm_pending": prewarm_left,
-        }
+            return {
+                "device": self.idx,
+                "kind": self.kind,
+                "alive": alive,
+                "cordoned": self.cordoned,
+                "error": self.error,
+                "backlog": sum(len(b) for b in self._batches),
+                "jobs": self.jobs_done,
+                "batches": self.batches_done,
+                "busy_s": round(self.busy_s, 3),
+                "buckets": dict(self.buckets),
+                "warm": sorted(self.warm_buckets),
+                "prewarm_pending": self.prewarm_left,
+            }
 
 
 class DeviceWorker(_Worker):
@@ -440,8 +478,7 @@ class WorkerPool:
                 # /healthz progress adds up; the worker's warm-time
                 # error path owns the counting and the log line
                 worker = self.workers[0]
-            worker.prewarm_specs.append(spec)
-            worker.prewarm_left += 1
+            worker.add_prewarm(spec)
 
     def note_prewarm_done(self) -> None:
         with self._prewarm_lock:

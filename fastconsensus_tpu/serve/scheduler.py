@@ -31,7 +31,9 @@ requeue loop.
 Workers are duck-typed (tests drive the scheduler with plain stubs):
 ``idx`` (int device tag), ``eligible(exclude)`` (alive, not cordoned,
 not excluded), ``load()`` (queued jobs + unfinished pre-warm specs) and
-``warm_buckets`` (set of bucket keys this worker has executed).
+``is_warm(bucket)`` (whether this worker has executed the bucket — a
+locked accessor, because routing threads probe it while the worker
+thread updates its residency set).
 """
 
 from __future__ import annotations
@@ -87,7 +89,7 @@ class StickyScheduler:
             # spill (home overloaded) or first/renewed assignment (no
             # home, or the home is cordoned/excluded): least-loaded,
             # warm-capable first
-            warm = [w for w in candidates if bucket in w.warm_buckets
+            warm = [w for w in candidates if w.is_warm(bucket)
                     and w is not home]
             pool = warm or [w for w in candidates if w is not home] \
                 or candidates
@@ -103,6 +105,6 @@ class StickyScheduler:
                 self._reg.inc("serve.sched.rehomes")
             else:
                 self._reg.inc("serve.sched.spills")
-                if bucket not in pick.warm_buckets:
+                if not pick.is_warm(bucket):
                     self._reg.inc("serve.sched.spill_cold")
             return pick

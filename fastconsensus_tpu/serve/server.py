@@ -249,21 +249,24 @@ class ConsensusService:
         every request until drain.  Every pool worker calls this between
         batches, so the stream index and the reset are serialized under
         their own lock."""
-        if self._tracer is None or self._trace_jsonl is None:
+        # local bindings: tracer/jsonl are written once in start() and
+        # never change after the workers exist — hoisting them out of
+        # the locked region keeps the lock covering only the state it
+        # actually guards (the stream index + the file append)
+        tracer, jsonl = self._tracer, self._trace_jsonl
+        if tracer is None or jsonl is None:
             return
         with self._trace_lock:
-            new = self._tracer.events_since(self._streamed_events)
+            new = tracer.events_since(self._streamed_events)
             self._streamed_events += len(new)
             if self._streamed_events > TRACE_EVENT_WINDOW:
                 # atomic snapshot+clear (Tracer.drain_since): a span
                 # another worker closes between a separate read and
                 # clear() would vanish from memory AND the stream
-                new = new + self._tracer.drain_since(
-                    self._streamed_events)
+                new = new + tracer.drain_since(self._streamed_events)
                 self._streamed_events = 0
             if new:
-                with open(self._trace_jsonl, "a",
-                          encoding="utf-8") as fh:
+                with open(jsonl, "a", encoding="utf-8") as fh:
                     for ev in new:
                         fh.write(json.dumps({"kind": "span", **ev})
                                  + "\n")
@@ -656,7 +659,7 @@ class ConsensusService:
                             n_closure=bucket.n_closure,
                             seeds=list(range(rung)))
         if worker is not None:
-            worker.warm_buckets.add(bucket.key())
+            worker.note_warm(bucket.key())
         self._reg.inc("serve.prewarm.buckets")
         _logger.info(
             "fcserve pre-warmed %s ladder to B=%d on device %s "

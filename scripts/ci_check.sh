@@ -32,6 +32,48 @@ if [ "$fixture_rc" -ne 1 ]; then
     exit 1
 fi
 
+echo "== fcheck-concurrency: each bad_ fixture must fail with ITS rule =="
+# the concurrency pass is whole-program; running each violating fixture
+# alone pins that the right rule (not a neighbor) catches it, and that
+# the analyzer names the rule id in its output
+for pair in \
+    bad_guarded_field.py:guarded-field \
+    bad_lock_order.py:lock-order \
+    bad_blocking_lock.py:blocking-under-lock \
+    bad_notify_outside.py:notify-outside-lock \
+    bad_root_write.py:unguarded-root-write
+do
+    fixture="${pair%%:*}"
+    rule="${pair##*:}"
+    out=$(JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
+        "tests/analysis_fixtures/$fixture" --only "$rule" 2>&1)
+    rc=$?
+    if [ "$rc" -ne 1 ]; then
+        echo "fcheck exited $rc on $fixture (expected 1 via $rule)" >&2
+        echo "$out" >&2
+        exit 1
+    fi
+    if ! printf '%s' "$out" | grep -q "\[$rule\]"; then
+        echo "fcheck did not name rule $rule on $fixture" >&2
+        echo "$out" >&2
+        exit 1
+    fi
+done
+echo "concurrency fixtures: all 5 rules fire with their ids"
+
+echo "== fcheck-concurrency: pool stress under the lock-order recorder =="
+# ISSUE 7 acceptance: the recorder run over the pool stress reports an
+# acyclic observed graph consistent with the static analysis (their
+# union acyclic).  Includes the slow full-service variant.
+FCTPU_LOCK_ORDER=1 JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_concurrency_stress.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "lock-order stress failed (exit $rc)" >&2
+    exit $rc
+fi
+
 echo "== fcobs: bench-history regression gate (scripts/bench_report.py) =="
 # judges the committed BENCH_*.json / runs/bench_*.json history; no TPU,
 # no jax — exit 1 means the newest sequenced artifact regressed

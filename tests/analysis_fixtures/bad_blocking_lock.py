@@ -1,0 +1,34 @@
+"""Violating fixture: blocking calls while a lock is held — a sleep, an
+unbounded thread join, a device dispatch reached through a helper, and
+a Condition.wait() that drags a foreign lock into the wait."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+_done = threading.Condition()
+
+
+def hold_and_sleep():
+    with _lock:
+        time.sleep(5.0)            # every _lock waiter sleeps too
+
+
+def hold_and_join(worker_thread):
+    with _lock:
+        worker_thread.join()       # unbounded join under the lock
+
+
+def _dispatch(slab, detect, config):
+    return run_consensus(slab, detect, config)  # noqa: F821 — AST-only
+
+
+def hold_and_dispatch(slab):
+    with _lock:
+        return _dispatch(slab, None, None)  # blocks via the helper
+
+
+def wait_holding_foreign():
+    with _lock:
+        with _done:
+            _done.wait()           # _lock is held through the wait

@@ -1,0 +1,31 @@
+"""Violating fixture: snapshot-outside-lock on a guarded field.
+
+This reconstructs the PR 6 ``Tracer.drain_since`` pre-fix pattern: a
+worker thread records spans into a buffer under the lock, while the
+flusher SNAPSHOTS the buffer without the lock before clearing it under
+the lock — a span recorded between the snapshot and the clear vanishes
+from memory without ever being streamed.
+"""
+
+import threading
+
+
+class SpanBuffer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+        self._thread = threading.Thread(target=self._worker)
+
+    def _worker(self):
+        while True:
+            self.record({"name": "span"})
+
+    def record(self, ev):
+        with self._lock:
+            self._events += [ev]
+
+    def flush(self):
+        tail = list(self._events)   # snapshot WITHOUT the lock
+        with self._lock:
+            self._events = []       # ...then clear under it: spans
+        return tail                 # recorded in between are lost

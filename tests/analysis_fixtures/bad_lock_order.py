@@ -1,0 +1,28 @@
+"""Violating fixture: inconsistent lock acquisition order.
+
+``transfer_ab`` takes _ledger then (through the helper) _audit;
+``transfer_ba`` takes _audit then _ledger.  Two threads running one
+each deadlock.  The _ledger -> _audit edge crosses a call boundary, so
+the rule's call-table propagation is what catches it.
+"""
+
+import threading
+
+_ledger = threading.Lock()
+_audit = threading.Lock()
+
+
+def _log_entry(n):
+    with _audit:
+        return n
+
+
+def transfer_ab(n):
+    with _ledger:
+        return _log_entry(n)     # _ledger -> _audit (via the helper)
+
+
+def transfer_ba(n):
+    with _audit:
+        with _ledger:            # _audit -> _ledger: the cycle
+            return n

@@ -1,0 +1,20 @@
+"""Violating fixture: Condition.notify outside its own ``with`` — a
+RuntimeError on exactly the path nobody tested."""
+
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+
+    def put_racy(self, item):
+        with self._cond:
+            self._items += [item]
+        self._cond.notify()        # the lock is already released
+
+    def put_ok(self, item):
+        with self._cond:
+            self._items += [item]
+            self._cond.notify()

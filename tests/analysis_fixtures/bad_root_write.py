@@ -1,0 +1,37 @@
+"""Violating fixture: worker-thread roots writing shared state with no
+guard at all — a module global and an instance attribute, each also
+read from the external (caller) root."""
+
+import threading
+
+progress = 0
+
+
+def worker_loop():
+    global progress
+    for i in range(100):
+        progress = i               # unguarded write from a thread root
+
+
+def start():
+    t = threading.Thread(target=worker_loop)
+    t.start()
+    return t
+
+
+def read_progress():
+    global progress
+    return progress
+
+
+class Poller:
+    def __init__(self):
+        self.last_seen = None
+        self._thread = threading.Thread(target=self._poll)
+
+    def _poll(self):
+        while True:
+            self.last_seen = object()   # unguarded write from the root
+
+    def status(self):
+        return self.last_seen

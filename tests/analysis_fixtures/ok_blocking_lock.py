@@ -1,0 +1,38 @@
+"""Clean twin of bad_blocking_lock.py: snapshot under the lock, block
+outside it; waiting holds only the condition's own lock."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+_done = threading.Condition()
+_pending = []
+
+
+def sleep_outside():
+    with _lock:
+        n = len(_pending)
+    time.sleep(0.01)               # the lock is long released
+    return n
+
+
+def join_outside(worker_thread):
+    with _lock:
+        _pending.append(worker_thread)
+    worker_thread.join()
+
+
+def _dispatch(slab, detect, config):
+    return run_consensus(slab, detect, config)  # noqa: F821 — AST-only
+
+
+def dispatch_outside(slab):
+    with _lock:
+        job = list(_pending)
+    return _dispatch(job or slab, None, None)
+
+
+def wait_own_lock_only():
+    with _done:
+        _done.wait()               # the protocol: only the condition's
+    return True                    # own lock is held

@@ -1,0 +1,25 @@
+"""Clean twin of bad_guarded_field.py: the snapshot and the clear are
+one atomic operation under the lock (the ``Tracer.drain_since`` fix)."""
+
+import threading
+
+
+class SafeSpanBuffer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+        self._thread = threading.Thread(target=self._worker)
+
+    def _worker(self):
+        while True:
+            self.record({"name": "span"})
+
+    def record(self, ev):
+        with self._lock:
+            self._events += [ev]
+
+    def flush(self):
+        with self._lock:
+            tail = list(self._events)
+            self._events = []
+        return tail

@@ -1,0 +1,23 @@
+"""Clean twin of bad_lock_order.py: one global order (_ledger before
+_audit) on every path."""
+
+import threading
+
+_ledger = threading.Lock()
+_audit = threading.Lock()
+
+
+def _log_entry(n):
+    with _audit:
+        return n
+
+
+def transfer_ab(n):
+    with _ledger:
+        return _log_entry(n)     # _ledger -> _audit
+
+
+def transfer_ba(n):
+    with _ledger:
+        with _audit:             # same order: no cycle
+            return n

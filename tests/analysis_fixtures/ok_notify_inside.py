@@ -1,0 +1,19 @@
+"""Clean twin of bad_notify_outside.py: every notify is lexically
+inside the owning ``with``."""
+
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._items = []
+
+    def put(self, item):
+        with self._cond:
+            self._items += [item]
+            self._cond.notify()
+
+    def close(self):
+        with self._cond:
+            self._cond.notify_all()

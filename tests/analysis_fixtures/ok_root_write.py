@@ -1,0 +1,42 @@
+"""Clean twin of bad_root_write.py: both sides of each shared-state
+access hold the guarding lock."""
+
+import threading
+
+_lock = threading.Lock()
+progress = 0
+
+
+def worker_loop():
+    global progress
+    for i in range(100):
+        with _lock:
+            progress = i
+
+
+def start():
+    t = threading.Thread(target=worker_loop)
+    t.start()
+    return t
+
+
+def read_progress():
+    global progress
+    with _lock:
+        return progress
+
+
+class Poller:
+    def __init__(self):
+        self._plock = threading.Lock()
+        self.last_seen = None
+        self._thread = threading.Thread(target=self._poll)
+
+    def _poll(self):
+        while True:
+            with self._plock:
+                self.last_seen = object()
+
+    def status(self):
+        with self._plock:
+            return self.last_seen

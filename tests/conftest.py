@@ -70,6 +70,15 @@ except Exception:
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Opt-in lock-order recording (analysis/lockorder.py): FCTPU_LOCK_ORDER=1
+# wraps threading.Lock/RLock/Condition for locks created from package
+# code, so the whole suite runs with the observed acquisition digraph
+# accumulating; the stress test asserts it stays acyclic.  Must install
+# BEFORE test modules import serve/obs classes that construct locks.
+from fastconsensus_tpu.analysis import lockorder as _lockorder  # noqa: E402
+
+_lockorder.maybe_install_from_env()
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -166,6 +166,150 @@ def test_key_reuse_helper_summaries_shapes():
     assert weights == {"derive": 0, "one": 1, "two": 2}
 
 
+CONCURRENCY_FIXTURES = [
+    ("bad_guarded_field.py", "ok_guarded_field.py", "guarded-field", 1),
+    ("bad_lock_order.py", "ok_lock_order.py", "lock-order", 1),
+    ("bad_blocking_lock.py", "ok_blocking_lock.py",
+     "blocking-under-lock", 4),
+    ("bad_notify_outside.py", "ok_notify_inside.py",
+     "notify-outside-lock", 1),
+    ("bad_root_write.py", "ok_root_write.py", "unguarded-root-write", 2),
+]
+
+
+def _lint_conc(name):
+    """Concurrency rules run through lint_paths (the pass is
+    whole-program, not per-source)."""
+    from fastconsensus_tpu.analysis import Report, lint_paths
+
+    return lint_paths([os.path.join(FIXTURES, name)],
+                      Report()).diagnostics
+
+
+@pytest.mark.parametrize("bad,ok,rule,n_bad", CONCURRENCY_FIXTURES,
+                         ids=[r[2] for r in CONCURRENCY_FIXTURES])
+def test_concurrency_rule_fires_on_bad_and_not_on_ok(bad, ok, rule,
+                                                     n_bad):
+    hits = [d for d in _lint_conc(bad) if d.rule == rule]
+    assert len(hits) == n_bad, (rule, [d.format() for d in hits])
+    assert not [d for d in _lint_conc(ok) if d.rule == rule], \
+        [d.format() for d in _lint_conc(ok)]
+
+
+def test_drain_since_prefix_race_is_caught_by_guarded_field():
+    """ISSUE 7 acceptance: the PR 6 ``Tracer.drain_since`` pre-fix
+    pattern — snapshot the span buffer outside the lock, clear it under
+    the lock — reconstructed as a fixture, must be caught by the
+    guarded-field rule at the unlocked snapshot."""
+    hits = [d for d in _lint_conc("bad_guarded_field.py")
+            if d.rule == "guarded-field"]
+    assert len(hits) == 1, [d.format() for d in hits]
+    assert "_events" in hits[0].message
+    # ...and the fixed shape (one atomic snapshot+clear) is clean
+    assert not _lint_conc("ok_guarded_field.py")
+
+
+def test_concurrency_lock_order_cross_function_edge():
+    """The cycle in bad_lock_order.py crosses a call boundary
+    (_ledger held -> helper acquires _audit): the finding proves the
+    call-table propagation works, not just lexical nesting."""
+    hits = [d for d in _lint_conc("bad_lock_order.py")
+            if d.rule == "lock-order"]
+    assert len(hits) == 1
+    assert "_ledger" in hits[0].message and "_audit" in hits[0].message
+
+
+def test_static_lock_graph_of_the_repo_is_acyclic():
+    """The whole package's static acquisition-order digraph must be
+    acyclic (the same graph the runtime recorder is checked against in
+    tests/test_concurrency_stress.py)."""
+    from fastconsensus_tpu.analysis.concurrency import (find_cycle,
+                                                        static_lock_graph)
+
+    pkg = os.path.join(os.path.dirname(__file__), "..",
+                       "fastconsensus_tpu")
+    sources = {}
+    for root, dirs, names in os.walk(pkg):
+        dirs[:] = [d for d in dirs if d not in ("__pycache__", "build",
+                                                "src")]
+        for f in names:
+            if f.endswith(".py"):
+                path = os.path.join(root, f)
+                with open(path, encoding="utf-8") as fh:
+                    sources[path] = fh.read()
+    graph = static_lock_graph(sources)
+    assert graph, "expected at least one static lock-order edge"
+    assert find_cycle(graph) is None, find_cycle(graph)
+
+
+def test_find_cycle_detects_and_clears():
+    from fastconsensus_tpu.analysis.concurrency import find_cycle
+
+    assert find_cycle({("a", "b"), ("b", "c")}) is None
+    cyc = find_cycle({("a", "b"), ("b", "c"), ("c", "a")})
+    assert cyc is not None and set(cyc) == {"a", "b", "c"}
+    assert find_cycle({("a", "a")}) == ["a"]
+
+
+def test_cli_only_filters_rules():
+    """--only keeps the selected rules (and skips the jaxpr audit when
+    none of them is jaxpr-*), so CI can archive per-rule reports and a
+    developer can iterate on one rule."""
+    import json
+    import tempfile
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    root = os.path.join(os.path.dirname(__file__), "..")
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "only.json")
+        r = subprocess.run(
+            [sys.executable, "-m", "fastconsensus_tpu.analysis",
+             FIXTURES, "--quiet", "--only", "lock-order,guarded-field",
+             "--json", out],
+            cwd=root, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert r.returncode == 1, r.stdout + r.stderr
+        blob = json.loads(open(out).read())
+        rules = {d["rule"] for d in blob["diagnostics"]}
+        assert rules == {"lock-order", "guarded-field"}, rules
+        # a bad fixture filtered down to an unrelated rule exits clean
+        r2 = subprocess.run(
+            [sys.executable, "-m", "fastconsensus_tpu.analysis",
+             os.path.join(FIXTURES, "bad_lock_order.py"), "--quiet",
+             "--only", "key-reuse"],
+            cwd=root, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_lockorder_recorder_forced_inversion_is_caught():
+    """Unit pin for the runtime half: two package locks acquired in
+    both orders under the recorder must fail assert_acyclic, and the
+    factories must be restored after the recording block."""
+    import threading
+
+    from fastconsensus_tpu.analysis import lockorder
+
+    with lockorder.recording() as rec:
+        from fastconsensus_tpu.serve.cache import ResultCache
+        from fastconsensus_tpu.serve.queue import AdmissionQueue
+
+        q = AdmissionQueue(4)
+        c = ResultCache(max_entries=4)
+        with c._lock:
+            q.depth()          # cache -> queue
+        rec.assert_acyclic()   # one direction alone is fine
+        with q._cond:
+            c.get("k")         # queue -> cache: the inversion
+        with pytest.raises(AssertionError, match="lock-order cycle"):
+            rec.assert_acyclic()
+    if not lockorder._installed:
+        # outside FCTPU_LOCK_ORDER=1 runs the recording block must
+        # restore the real factories; under env-install they stay
+        # patched by design (the suite-wide recorder keeps going)
+        assert threading.Lock is lockorder._REAL["Lock"]
+
+
 def test_pragma_suppresses_and_is_counted():
     diags, suppressed = _lint("ok_sync_outside.py")
     assert not diags, [d.format() for d in diags]

@@ -54,6 +54,9 @@ class _StubWorker:
     def load(self):
         return self._load
 
+    def is_warm(self, bucket):
+        return bucket in self.warm_buckets
+
 
 def test_scheduler_sticky_home_and_spill():
     from fastconsensus_tpu.obs import counters as obs_counters
