@@ -1,7 +1,7 @@
 """fcheck: the project's static-analysis suite (AST lint + concurrency
-pass + jaxpr audit + runtime guards).
+pass + jaxpr audit + footprint model + runtime guards).
 
-Four layers, one report (run ``python -m fastconsensus_tpu.analysis``):
+Five layers, one report (run ``python -m fastconsensus_tpu.analysis``):
 
 1. **AST lint** (analysis/astlint.py) — project-specific source rules:
    PRNG key reuse, Python control flow on traced values, retrace
@@ -15,7 +15,15 @@ Four layers, one report (run ``python -m fastconsensus_tpu.analysis``):
    jitted entry point (analysis/entrypoints.py) at canonical shapes and
    walks the staged program for forbidden primitives (f64 casts,
    embedded device_put, ungated huge gathers).
-4. **Runtime guards** — :class:`CompileGuard`
+4. **Footprint model** (analysis/footprint.py) — the serving stack's
+   compile-time memory and executable-surface model: a donation-aware
+   liveness sweep over traced jaxprs prices every executable the bucket
+   ladder implies (``jaxpr-peak-bytes`` vs a per-chip budget), the
+   enumerated surface is budgeted (``surface-count``), bucket padding
+   is budgeted (``padding-waste``), and ``derive_chip_ceiling`` feeds
+   the model back into serving (``serve --chip-max-edges auto`` and
+   startup ``--warm`` validation).
+5. **Runtime guards** — :class:`CompileGuard`
    (analysis/recompile_guard.py) bounds XLA compilations over a region
    (the tier-1 compile-budget pins), and the opt-in lock-order recorder
    (analysis/lockorder.py, ``FCTPU_LOCK_ORDER=1``) logs the observed

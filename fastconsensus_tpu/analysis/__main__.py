@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+from dataclasses import replace as dataclasses_replace
 from typing import List, Optional
 
 
@@ -41,7 +42,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--jaxpr", dest="jaxpr", action="store_true",
                         default=None, help="force the jaxpr audit on")
     parser.add_argument("--no-jaxpr", dest="jaxpr", action="store_false",
-                        help="skip the jaxpr audit (pure source lint)")
+                        help="skip the jaxpr audit and every traced "
+                             "footprint probe (pure source lint + grid "
+                             "math, no jax import); --only "
+                             "jaxpr-peak-bytes still re-enables that "
+                             "one traced rule explicitly")
     parser.add_argument("--entry-point", action="append", default=None,
                         metavar="NAME",
                         help="audit only these entry points (repeatable)")
@@ -56,6 +61,35 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--gather-threshold", type=int, default=1 << 26,
                         help="jaxpr audit: max elements one gather may "
                              "materialize (default 2^26)")
+    parser.add_argument("--footprint", dest="footprint",
+                        action="store_true", default=None,
+                        help="force the footprint pass on (memory & "
+                             "surface model; analysis/footprint.py)")
+    parser.add_argument("--no-footprint", dest="footprint",
+                        action="store_false",
+                        help="skip the footprint pass")
+    parser.add_argument("--hbm-bytes", type=int, default=None,
+                        metavar="BYTES",
+                        help="per-chip device-memory budget for the "
+                             "jaxpr-peak-bytes rule (default: the "
+                             "CI-pinned synthetic budget, "
+                             "footprint.CHIP_HBM_BYTES_DEFAULT)")
+    parser.add_argument("--surface-budget", type=int, default=None,
+                        metavar="N",
+                        help="executable-count budget for the "
+                             "surface-count rule (default pinned in "
+                             "footprint.SURFACE_BUDGET_DEFAULT)")
+    parser.add_argument("--pad-waste-frac", type=float, default=None,
+                        metavar="FRAC",
+                        help="padding-waste threshold: worst-case pad "
+                             "bytes / payload bytes per bucket "
+                             "(default pinned in footprint."
+                             "PAD_WASTE_FRAC_DEFAULT)")
+    parser.add_argument("--footprint-out", metavar="PATH", default=None,
+                        help="also write the footprint block alone as a "
+                             "bench-history artifact (runs/"
+                             "footprint_rNN.json; scripts/bench_report."
+                             "py renders and gates it)")
     parser.add_argument("--quiet", action="store_true",
                         help="suppress per-diagnostic output")
     args = parser.parse_args(argv)
@@ -77,8 +111,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         from fastconsensus_tpu.analysis.astlint import ASTLINT_RULES
         from fastconsensus_tpu.analysis.concurrency import \
             CONCURRENCY_RULES
+        from fastconsensus_tpu.analysis.footprint import FOOTPRINT_RULES
 
-        known = set(ASTLINT_RULES) | set(CONCURRENCY_RULES) | {
+        known = set(ASTLINT_RULES) | set(CONCURRENCY_RULES) | \
+            set(FOOTPRINT_RULES) | {
             "jaxpr-f64", "jaxpr-device-put", "jaxpr-gather-size",
             "trace-error"}
         only = {r.strip() for r in args.only.split(",") if r.strip()}
@@ -103,7 +139,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
             diags, summary = audit_entry_points(
                 names=args.entry_point,
-                gather_threshold=args.gather_threshold)
+                gather_threshold=args.gather_threshold,
+                hbm_bytes=args.hbm_bytes)
             report.extend(diags)
             report.jaxpr_summary = summary
         except Exception as e:  # noqa: BLE001 — analyzer must not crash CI
@@ -111,9 +148,85 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             return 2
 
+    # -- footprint pass (analysis/footprint.py): device-memory & surface
+    # model.  Runs on package scans (like the jaxpr audit) or whenever a
+    # scanned fixture declares a FOOTPRINT_SPEC posture; --only without
+    # any footprint rule skips it, and only the jaxpr-peak-bytes rule
+    # ever imports jax (surface-count / padding-waste are grid math, so
+    # the pre-commit hook stays jax-free).
+    from fastconsensus_tpu.analysis import footprint as fpmod
+
+    run_footprint = args.footprint
+    fixture_specs = []
+    if run_footprint is not False and (
+            only is None or only & set(fpmod.FOOTPRINT_RULES)):
+        try:
+            fixture_specs = fpmod.find_specs(paths)
+        except ValueError as e:
+            print(f"fcheck: bad FOOTPRINT_SPEC: {e}", file=sys.stderr)
+            return 2
+        if run_footprint is None:
+            run_footprint = _inside_package(paths) or bool(fixture_specs)
+    elif run_footprint is None:
+        run_footprint = False
+    if run_footprint and only is not None and \
+            not (only & set(fpmod.FOOTPRINT_RULES)):
+        run_footprint = False
+    if run_footprint:
+        overrides = {k: v for k, v in (
+            ("hbm_bytes", args.hbm_bytes),
+            ("surface_budget", args.surface_budget),
+            ("pad_waste_frac", args.pad_waste_frac)) if v is not None}
+        specs = fixture_specs or [fpmod.SurfaceSpec()]
+        if overrides:
+            specs = [dataclasses_replace(s, **overrides) for s in specs]
+        sel = set(only & set(fpmod.FOOTPRINT_RULES)) if only is not None \
+            else set(fpmod.FOOTPRINT_RULES)
+        if args.jaxpr is False and (only is None
+                                    or "jaxpr-peak-bytes" not in only):
+            # --no-jaxpr promises "no jax import": keep the footprint
+            # pass to its grid-math rules (the per-file pre-commit hook
+            # lands here) unless the traced rule was NAMED via --only —
+            # an explicit selection wins over the default scope
+            sel -= {"jaxpr-peak-bytes"}
+        try:
+            for spec in specs:
+                # the repo-default posture carries the full table +
+                # derived ceiling into the report; fixture postures,
+                # --only rule-iteration runs and --no-jaxpr (both
+                # traced) contribute diagnostics only — the table is
+                # ~25 traces, which the full-report runs pay and the
+                # per-rule/per-commit loops must not
+                full = not fixture_specs and only is None \
+                    and args.jaxpr is not False
+                diags, block = fpmod.evaluate(spec, rules=sel,
+                                              with_table=full,
+                                              with_ceiling=full)
+                report.extend(diags)
+                if full:
+                    report.footprint = block
+        except Exception as e:  # noqa: BLE001 — analyzer must not crash CI
+            print(f"fcheck: footprint pass failed to run: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+
     if only is not None:
         report.diagnostics = [d for d in report.diagnostics
                               if d.rule in only]
+
+    if args.footprint_out:
+        if report.footprint is None:
+            print("fcheck: --footprint-out needs the footprint pass on "
+                  "the repo posture (no fixture specs, a footprint rule "
+                  "selected)", file=sys.stderr)
+            return 2
+        import json as _json
+
+        out_dir = os.path.dirname(os.path.abspath(args.footprint_out))
+        os.makedirs(out_dir, exist_ok=True)
+        with open(args.footprint_out, "w", encoding="utf-8") as fh:
+            _json.dump(report.footprint, fh, indent=2, sort_keys=True)
+            fh.write("\n")
 
     if args.json:
         os.makedirs(os.path.dirname(os.path.abspath(args.json)),

@@ -103,9 +103,13 @@ class Report:
     diagnostics: List[Diagnostic] = dataclasses.field(default_factory=list)
     n_files: int = 0
     n_suppressed: int = 0
-    # per-entry-point jaxpr audit summaries (entrypoint -> primitive counts)
+    # per-entry-point jaxpr audit summaries (entrypoint -> primitive
+    # counts, plus the liveness-sweep "peak_bytes" entry)
     jaxpr_summary: Dict[str, Dict[str, int]] = dataclasses.field(
         default_factory=dict)
+    # the footprint block (analysis/footprint.py module docstring
+    # documents the schema); None when the footprint pass did not run
+    footprint: Optional[dict] = None
 
     def extend(self, diags: List[Diagnostic]) -> None:
         self.diagnostics.extend(diags)
@@ -125,6 +129,7 @@ class Report:
             "n_suppressed": self.n_suppressed,
             "diagnostics": [d.to_dict() for d in self.diagnostics],
             "jaxpr_entry_points": self.jaxpr_summary,
+            "footprint": self.footprint,
         }, indent=2, sort_keys=True)
 
     def format_human(self) -> str:
@@ -135,4 +140,13 @@ class Report:
             f"({self.n_errors} error) in {self.n_files} file(s), "
             f"{self.n_suppressed} suppressed by pragma, "
             f"{len(self.jaxpr_summary)} jaxpr entry point(s) audited")
+        if self.footprint is not None:
+            fp = self.footprint
+            ceil = fp.get("chip_ceiling_edges")
+            lines.append(
+                f"fcheck-footprint: {fp.get('surface_count')} surface "
+                f"executable(s) (budget {fp.get('surface_budget')}), "
+                f"max pad {fp.get('max_pad_frac'):.0%}, "
+                f"chip ceiling "
+                f"{ceil if ceil is not None else 'n/a'} edges")
         return "\n".join(lines)

@@ -229,3 +229,126 @@ def entry_points() -> List[EntryPoint]:
 
 def entry_point_names() -> List[str]:
     return [ep.name for ep in entry_points()]
+
+
+# ---------------------------------------------------------------------
+# Serving-surface metadata: how to trace any executable of the bucketed
+# serving ladder at an ARBITRARY bucket, for the footprint model
+# (analysis/footprint.py).  Operands are jax.ShapeDtypeStruct only —
+# tracing needs avals, not data, so modeling the n1048576_e4194304
+# frontier bucket costs ~1 s and zero device memory.  The slab statics
+# are the bucket-canonical ones serve/bucketer.pad_to_bucket pins
+# (d_cap/d_hyb/hub_cap = 0, cap_hint = capacity, agg_cap derived), so
+# the traced program IS the one a served request of that bucket runs.
+# ---------------------------------------------------------------------
+
+SERVING_KINDS = ("rounds", "batch", "tail", "detect", "detect-batch")
+
+
+def _bucket_slab_struct(n_class: int, e_class: int,
+                        batch: Optional[int] = None):
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.graph import GraphSlab, derive_agg_sizing
+
+    cap = 2 * e_class + 16           # bucketer.Bucket.capacity
+    lead = () if batch is None else (batch,)
+
+    def sds(shape, dtype):
+        return jax.ShapeDtypeStruct(lead + shape, dtype)
+
+    return GraphSlab(
+        src=sds((cap,), jnp.int32), dst=sds((cap,), jnp.int32),
+        weight=sds((cap,), jnp.float32), alive=sds((cap,), jnp.bool_),
+        n_nodes=n_class, d_cap=0, cap_hint=cap, d_hyb=0, hub_cap=0,
+        agg_cap=derive_agg_sizing(cap))
+
+
+def trace_serving_executable(kind: str, n_class: int, e_class: int,
+                             b: int = 1, mode: str = "warm",
+                             n_p: int = 20, algorithm: str = "louvain"):
+    """ClosedJaxpr of one serving-surface executable at bucket
+    (n_class, e_class).
+
+    ``kind`` is one of :data:`SERVING_KINDS`, mirroring the engine's
+    lru-cached jit wrappers a served bucket compiles through:
+    ``"rounds"`` — the solo fused rounds block
+    (engine._jitted_rounds_block; ``mode`` "warm"/"scratch" selects the
+    static warm flag); ``"batch"`` — the B-vmapped batch block
+    (engine._jitted_rounds_batch; ``mode`` warm/cold/scratch, ``b`` the
+    rung); ``"tail"`` / ``"detect"`` / ``"detect-batch"`` — the
+    consensus tail and the final whole-ensemble detection (solo and
+    B-vmapped).  ``n_closure`` is the bucket-canonical e_class, exactly
+    as serve/server.py passes it.
+    """
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu import policy
+    from fastconsensus_tpu.engine import (consensus_batch_block,
+                                          consensus_rounds_block,
+                                          consensus_tail)
+    from fastconsensus_tpu.models.registry import get_detector
+
+    if kind not in SERVING_KINDS:
+        raise ValueError(f"unknown serving kind {kind!r}; one of "
+                         f"{SERVING_KINDS}")
+    det = get_detector(algorithm)
+    det_warm = getattr(det, "warm_variant", None) or det
+    det_refresh = getattr(det, "refresh_variant", None) or det
+    key_aval = jax.eval_shape(lambda: jax.random.key(0))
+    tau, delta, block = 0.2, 0.02, 8
+    n, L = n_class, e_class
+
+    def sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    if kind == "rounds":
+        assert mode in ("warm", "scratch"), mode
+        slab = _bucket_slab_struct(n_class, e_class)
+        pst = policy.PolicyState(*(sds((), jnp.int32)
+                                   for _ in policy.PolicyState._fields))
+        fn = functools.partial(
+            consensus_rounds_block, detect=det, detect_warm=det_warm,
+            detect_refresh=det_refresh, n_p=n_p, tau=tau, delta=delta,
+            n_closure=L, block=block, warm=(mode == "warm"),
+            align_frac=1.0, sampler="csr")
+        return jax.make_jaxpr(fn)(
+            slab, sds((), key_aval.dtype), sds((n_p, n), jnp.int32),
+            sds((), jnp.int32), sds((), jnp.int32), sds((), jnp.bool_),
+            pst, sds((), jnp.bool_), sds((3,), jnp.int32))
+    if kind == "batch":
+        assert mode in ("warm", "cold", "scratch"), mode
+        d = det_warm if mode == "warm" else det
+        slab = _bucket_slab_struct(n_class, e_class, batch=b)
+        pst = policy.PolicyState(*(sds((b,), jnp.int32)
+                                   for _ in policy.PolicyState._fields))
+        fn = jax.vmap(functools.partial(
+            consensus_batch_block, detect=d, n_p=n_p, tau=tau,
+            delta=delta, n_closure=L, block=block, mode=mode,
+            align_frac=1.0 if mode == "warm" else 0.0, sampler="csr"))
+        return jax.make_jaxpr(fn)(
+            slab, sds((b,), key_aval.dtype),
+            sds((b, n_p, n), jnp.int32), sds((b,), jnp.int32),
+            sds((b,), jnp.int32), sds((b,), jnp.bool_), pst,
+            sds((b,), jnp.bool_), sds((b, 3), jnp.int32))
+    if kind == "tail":
+        slab = _bucket_slab_struct(n_class, e_class)
+        fn = functools.partial(consensus_tail, n_p=n_p, tau=tau,
+                               delta=delta, n_closure=L, sampler="csr")
+        return jax.make_jaxpr(fn)(slab, sds((n_p, n), jnp.int32),
+                                  sds((), key_aval.dtype))
+    if kind == "detect":
+        slab = _bucket_slab_struct(n_class, e_class)
+        return jax.make_jaxpr(
+            lambda s, k, i: det_warm(s, k, i))(
+            slab, sds((n_p,), key_aval.dtype), sds((n_p, n), jnp.int32))
+    # detect-batch: the B-vmapped final re-detection
+    slab = _bucket_slab_struct(n_class, e_class, batch=b)
+    return jax.make_jaxpr(
+        jax.vmap(lambda s, k, i: det_warm(s, k, i)))(
+        slab, sds((b, n_p), key_aval.dtype),
+        sds((b, n_p, n), jnp.int32))

@@ -1,0 +1,838 @@
+"""fcheck-footprint: static device-memory & executable-surface model of
+the serving stack.
+
+The serving layer compiles one executable per (entry kind x bucket rung
+x batch rung x engine mode) and, until now, nothing modeled what any of
+those executables *costs* before it ran: an over-budget ``--warm`` spec
+or a new ladder rung was discovered as a runtime OOM on first traffic,
+and a static-arg axis quietly multiplying the executable surface was
+discovered as a compile-count regression after the fact.  This module is
+the compile-time answer — the HBM-budget / compile-surface lint of a
+training stack, specialized to the bucketed serving ladder:
+
+1. **Liveness sweep** (:func:`peak_live_bytes`): an abstract interpreter
+   over a traced jaxpr that computes peak live device bytes — program
+   arguments (donation-aware: a donated invar dies at its last use, a
+   non-donated one is pinned for the whole execution, which is XLA's
+   buffer contract), outputs, and the high-water set of temporaries,
+   recursing through pjit/while/cond/scan sub-jaxprs.  Exact for what
+   the jaxpr says; deliberately blind to XLA fusion (fusion only ever
+   *lowers* the true peak, so the model is a conservative ceiling).
+2. **Surface enumeration** (:func:`surface_count`, jax-free): every
+   executable a serving posture implies — the ``{2^k, 3*2^k}`` bucket
+   ladder (serve/bucketer.py) x the batch ladder {1, 2, 4, 8} x the
+   engine's static modes (warm/cold/scratch batch blocks, warm/scratch
+   solo blocks, tail, final detect).  The static complement of the
+   runtime ``CompileGuard``: a new static-arg axis multiplies this count
+   at review time, not after a week of recompiles in production.
+3. **The serving feedback** (:func:`derive_chip_ceiling`): the largest
+   ladder bucket whose worst-case executable set fits a per-chip byte
+   budget — what ``serve --chip-max-edges auto`` routes on, and what
+   every ``--warm`` spec is validated against at server start.
+
+Three fcheck rules ride on the model (all exposed via ``--only``):
+
+* ``jaxpr-peak-bytes``  — some surface executable's modeled peak
+  exceeds the per-chip budget (``--hbm-bytes``; the default is the
+  CI-pinned synthetic budget below).  The peak is NOT globally monotone
+  in bucket size: the detectors self-limit per-sweep temporaries with a
+  per-graph ensemble-chunk budget (models/base.py) whose estimate
+  tightens as buckets grow, so the worst executable sits at an
+  *interior* bucket (and the batch path multiplies that per-graph
+  budget by every batch lane — a fact this model surfaced).  The gate
+  therefore SCANS the edge ladder at the two worst-case node rows — the
+  densest-connected posture ``n = 2e`` and the isolated-node-padded
+  posture ``n = max_nodes`` — with the dominant executable kind, then
+  prices every kind at the scan winners and the matmul-path frontier.
+  Within one detection-path regime at fixed chunking the peak IS
+  monotone along the ladder (pinned by tests/test_footprint.py).
+* ``surface-count``     — the enumerated executable count exceeds a
+  pinned budget (``--surface-budget``).
+* ``padding-waste``     — some bucket's padding exceeds a configured
+  fraction of its worst-case member's payload (``--pad-waste-frac``):
+  the ladder's geometry bounds waste below ~50%, and this rule is the
+  tripwire for a ladder edit that silently breaks that bound.
+
+**Fixture mode**: a scanned source file may define a module-level
+``FOOTPRINT_SPEC = {...}`` literal (see :meth:`SurfaceSpec.from_mapping`
+for the keys); the analyzer then evaluates the rules against *that*
+posture instead of the repo default — this is how the bad_/ok_ fixtures
+in tests/analysis_fixtures/ exercise each rule in isolation.
+
+**Report / artifact schema** (the ``footprint`` block of the ``--json``
+report, and the committed ``runs/footprint_rNN.json`` artifact rendered
+and gated by ``scripts/bench_report.py``)::
+
+    {
+      "tool": "fcheck-footprint", "version": 1,
+      "config":  {max_nodes, max_edges, max_batch, n_p, algorithm,
+                  hbm_bytes, surface_budget, pad_waste_frac},
+      "surface_count":      <int>,   # enumerated executables
+      "surface_budget":     <int>,
+      "chip_ceiling_edges": <int|null>,  # derive_chip_ceiling(hbm)
+      "max_pad_frac":       <float>, # worst non-floor bucket
+      "gate": [ {kind, bucket, batch, mode, peak_bytes} ... ],
+      "buckets": [                    # the footprint table (e-spine)
+        {bucket, n_class, e_class, capacity, batch,
+         peak_bytes,        # batched block, max rung, worst mode
+         solo_peak_bytes,   # solo rounds block (warm)
+         arg_bytes, out_bytes, pad_frac} ... ]
+    }
+
+The jax-free half (enumeration, padding) mirrors ``sizing.grid_up`` /
+``serve.bucketer`` constants locally so the pre-commit hook and the
+``--only surface-count,padding-waste`` path never import jax; the
+mirrors are pinned against the real functions by tests/test_footprint.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, \
+    Tuple
+
+from fastconsensus_tpu.analysis.diagnostics import Diagnostic
+
+# --------------------------------------------------------------------
+# CI-pinned budgets.
+# --------------------------------------------------------------------
+
+# Synthetic per-chip byte budget for the CPU CI gate.  The default
+# serving surface's worst executable — the B=8 batched final detect at
+# bucket n1048576_e262144, where the detector's per-graph
+# ensemble-chunk budget (models/base.py, ~2 GiB of sweep temporaries)
+# is multiplied by every one of the 8 batch lanes — models at
+# ~21.7 GiB, so the repo passes with ~10% headroom; growing the peak
+# past the budget (a new resident temporary, a looser chunk estimate)
+# fails the gate.  Real deployments pass their chip's actual budget via
+# --hbm-bytes and route what doesn't fit with --chip-max-edges auto.
+CHIP_HBM_BYTES_DEFAULT = 24 << 30
+
+# Enumerated-executable budget.  The default posture models 13,280
+# executables (830 reachable buckets x 16 kinds); the pin leaves ~23%
+# headroom for ladder growth while any new *static axis* (which
+# multiplies the count) blows it at review time.
+SURFACE_BUDGET_DEFAULT = 16384
+
+# Worst-case padding fraction per bucket.  The {2^k, 3*2^k} grid bounds
+# consecutive classes at a 3/2 ratio, so the worst member of any
+# non-floor bucket pads < 50% of its payload; 0.55 passes that geometry
+# and fails any ladder edit that opens a wider gap.
+PAD_WASTE_FRAC_DEFAULT = 0.55
+
+FOOTPRINT_RULES = ("jaxpr-peak-bytes", "surface-count", "padding-waste")
+
+# --------------------------------------------------------------------
+# jax-free mirrors of the ladder geometry (pinned by test_footprint.py
+# against sizing.grid_up / serve.bucketer / graph.derive_agg_sizing —
+# importing the real ones would pull jax into the pre-commit hook).
+# --------------------------------------------------------------------
+
+MIN_NODE_CLASS = 64          # serve.bucketer.MIN_NODE_CLASS
+MIN_EDGE_CLASS = 64          # serve.bucketer.MIN_EDGE_CLASS
+BATCH_RUNGS = (1, 2, 4, 8)   # serve.bucketer.BATCH_LADDER
+MATMUL_MAX_N = 1024          # models.louvain.MATMUL_MAX_N (path flip)
+
+# Engine executable kinds per bucket (mirrors the engine's lru-cached
+# jit wrappers a served bucket compiles through): the solo set — the
+# fused rounds block in its warm and scratch static variants
+# (engine._jitted_rounds_block), the consensus tail (_jitted_tail) and
+# the final whole-ensemble detect (_jitted_detect) — plus, per batch
+# rung > 1, the three static batch-block modes (_jitted_rounds_batch:
+# a vmapped lax.cond would run BOTH detector branches, so mode is a
+# static) and the batched final detect (_jitted_detect_batch).
+SOLO_KINDS = ("rounds[warm]", "rounds[scratch]", "tail", "detect")
+BATCH_MODES = ("warm", "cold", "scratch")
+KINDS_PER_RUNG = len(BATCH_MODES) + 1   # + the batched final detect
+
+
+def grid_up(n: int, minimum: int = 1) -> int:
+    """Smallest {2^k, 3*2^k} value >= n (mirror of sizing.grid_up)."""
+    n = max(int(n), int(minimum), 1)
+    p = 1
+    while p < n:
+        p *= 2
+    q = (3 * p) // 4
+    return q if p >= 4 and q >= n else p
+
+
+def grid_values(lo: int, hi: int) -> List[int]:
+    """Every grid class in [grid_up(lo), grid_up(hi)], ascending."""
+    lo, hi = grid_up(lo), grid_up(hi)
+    out, v = [], lo
+    while v <= hi:
+        out.append(v)
+        v = grid_up(v + 1)
+    return out
+
+
+def prev_class(c: int, minimum: int) -> Optional[int]:
+    """The grid class directly below ``c``, or None at the floor.
+
+    Closed form — a 2^k class sits above 3*2^(k-2) (= 3c/4) and a
+    3*2^k class above 2^(k+1) (= 2c/3); small classes (< 4) step by 1.
+    """
+    if c <= minimum:
+        return None
+    if c < 4:
+        prev = c - 1
+    elif c & (c - 1) == 0:               # power of two
+        prev = (3 * c) // 4
+    else:                                # 3 * 2^k
+        prev = (2 * c) // 3
+    return max(prev, minimum)
+
+
+def bucket_capacity(e_class: int) -> int:
+    """serve.bucketer.Bucket.capacity: pack_edges' default headroom."""
+    return 2 * e_class + 16
+
+
+def bucket_agg_cap(e_class: int) -> int:
+    """serve.bucketer.Bucket.agg_cap = graph.derive_agg_sizing(cap)."""
+    cap = bucket_capacity(e_class)
+    want = cap + cap // 8 + 1024
+    return ((want + 4095) // 4096) * 4096
+
+
+def bucket_bytes(n_class: int, e_class: int) -> int:
+    """Request-resident slab-state bytes for one bucket: 13 B per edge
+    slot (src/dst/weight int32+int32+f32 + alive bool) plus 8 B per node
+    (the per-node int32 working pair every reduction carries).  A proxy
+    for *payload scale*, used only by the padding rule — the executable
+    peak model measures real jaxprs, not this."""
+    return 13 * bucket_capacity(e_class) + 8 * n_class
+
+
+# --------------------------------------------------------------------
+# Surface posture
+# --------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SurfaceSpec:
+    """One serving posture: what the analyzer enumerates and budgets.
+
+    Defaults mirror ``serve.server.ServeConfig`` admission bounds and
+    batch ladder (pinned by test_footprint.py) and the engine's default
+    ensemble width.
+    """
+
+    max_nodes: int = 1 << 20
+    max_edges: int = 1 << 22
+    max_batch: int = 8
+    n_p: int = 20                      # ConsensusConfig default
+    algorithm: str = "louvain"
+    hbm_bytes: int = CHIP_HBM_BYTES_DEFAULT
+    surface_budget: int = SURFACE_BUDGET_DEFAULT
+    pad_waste_frac: float = PAD_WASTE_FRAC_DEFAULT
+    # Explicit edge-ladder override for the padding rule (fixture mode:
+    # a broken ladder must be expressible without editing bucketer).
+    grid: Optional[Tuple[int, ...]] = None
+    # Restrict evaluation to these rules (fixture mode; None = all).
+    rules: Optional[Tuple[str, ...]] = None
+    origin: str = "<defaults>"         # file the spec came from
+    origin_line: int = 0
+
+    _KEYS = ("max_nodes", "max_edges", "max_batch", "n_p", "algorithm",
+             "hbm_bytes", "surface_budget", "pad_waste_frac", "grid",
+             "rules")
+
+    @classmethod
+    def from_mapping(cls, d: Dict, origin: str = "<spec>",
+                     origin_line: int = 0) -> "SurfaceSpec":
+        unknown = set(d) - set(cls._KEYS)
+        if unknown:
+            raise ValueError(
+                f"{origin}: unknown FOOTPRINT_SPEC key(s) "
+                f"{sorted(unknown)}; known: {list(cls._KEYS)}")
+        kw = dict(d)
+        for k in ("grid", "rules"):
+            if kw.get(k) is not None:
+                kw[k] = tuple(kw[k])
+        if kw.get("rules"):
+            bad = set(kw["rules"]) - set(FOOTPRINT_RULES)
+            if bad:
+                raise ValueError(
+                    f"{origin}: FOOTPRINT_SPEC rules {sorted(bad)} are "
+                    f"not footprint rules {list(FOOTPRINT_RULES)}")
+        return cls(origin=origin, origin_line=origin_line, **kw)
+
+    def wants(self, rule: str) -> bool:
+        return self.rules is None or rule in self.rules
+
+
+def find_specs(paths: Iterable[str]) -> List[SurfaceSpec]:
+    """Module-level ``FOOTPRINT_SPEC = {...}`` literals in the scanned
+    sources (fixture mode).  Non-literal or unknown-key specs raise
+    ValueError — a typo'd fixture must not silently evaluate defaults.
+    """
+    import ast
+    import os
+
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", "build"))
+                files.extend(os.path.join(root, f) for f in sorted(names)
+                             if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    specs: List[SurfaceSpec] = []
+    for f in files:
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=f)
+        except (OSError, SyntaxError):
+            continue
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "FOOTPRINT_SPEC"
+                    for t in node.targets):
+                d = ast.literal_eval(node.value)   # ValueError on junk
+                if not isinstance(d, dict):
+                    raise ValueError(
+                        f"{f}:{node.lineno}: FOOTPRINT_SPEC must be a "
+                        f"dict literal")
+                specs.append(SurfaceSpec.from_mapping(
+                    d, origin=f, origin_line=node.lineno))
+    return specs
+
+
+# --------------------------------------------------------------------
+# Surface enumeration (jax-free)
+# --------------------------------------------------------------------
+
+
+def node_classes(spec: SurfaceSpec) -> List[int]:
+    return grid_values(MIN_NODE_CLASS, spec.max_nodes)
+
+
+def edge_classes(spec: SurfaceSpec) -> List[int]:
+    return grid_values(MIN_EDGE_CLASS, spec.max_edges)
+
+
+def min_member(c: int, minimum: int) -> int:
+    """Smallest raw value that lands in class ``c`` (floor classes
+    serve everything from 1 up)."""
+    prev = prev_class(c, minimum)
+    return 1 if prev is None else prev + 1
+
+
+def reachable(n_class: int, e_class: int, spec: SurfaceSpec) -> bool:
+    """Whether some admissible graph lands in bucket (n_class, e_class):
+    there must exist n <= max_nodes with grid_up(n) == n_class and
+    e <= min(max_edges, n*(n-1)/2) with grid_up(e) == e_class (a simple
+    graph cannot carry more than the complete graph's edges)."""
+    n_hi = min(n_class, spec.max_nodes)
+    if grid_up(n_hi, MIN_NODE_CLASS) != n_class:
+        return False
+    e_lo = min_member(e_class, MIN_EDGE_CLASS)
+    return e_lo <= min(spec.max_edges, n_hi * (n_hi - 1) // 2)
+
+
+def surface_buckets(spec: SurfaceSpec) -> List[Tuple[int, int]]:
+    return [(n, e) for n in node_classes(spec) for e in edge_classes(spec)
+            if reachable(n, e, spec)]
+
+
+def batch_rungs(max_batch: int) -> List[int]:
+    return [b for b in BATCH_RUNGS if b <= max(int(max_batch), 1)]
+
+
+def executables_per_bucket(spec: SurfaceSpec) -> int:
+    """Distinct executables one served bucket implies (see SOLO_KINDS /
+    BATCH_MODES): the solo set plus KINDS_PER_RUNG per batch rung > 1."""
+    n_rungs = len([b for b in batch_rungs(spec.max_batch) if b > 1])
+    return len(SOLO_KINDS) + KINDS_PER_RUNG * n_rungs
+
+
+def surface_count(spec: SurfaceSpec) -> int:
+    return len(surface_buckets(spec)) * executables_per_bucket(spec)
+
+
+def check_surface(spec: SurfaceSpec) -> List[Diagnostic]:
+    count = surface_count(spec)
+    if count <= spec.surface_budget:
+        return []
+    n_buckets = len(surface_buckets(spec))
+    return [Diagnostic(
+        rule="surface-count", file=spec.origin, line=spec.origin_line,
+        message=f"the serving posture (max_nodes={spec.max_nodes}, "
+                f"max_edges={spec.max_edges}, max_batch={spec.max_batch})"
+                f" implies {count} compiled executables ({n_buckets} "
+                f"reachable buckets x {executables_per_bucket(spec)} "
+                f"kinds) > budget {spec.surface_budget}: a static-arg "
+                f"axis or ladder change exploded the compile surface "
+                f"(the static complement of CompileGuard)")]
+
+
+# --------------------------------------------------------------------
+# Padding waste (jax-free)
+# --------------------------------------------------------------------
+
+
+def pad_fraction(n_class: int, e_class: int) -> Optional[float]:
+    """Worst-case pad bytes / payload bytes for one bucket: the member
+    with the fewest nodes AND edges that still lands here.  None for
+    floor buckets — the MIN_*_CLASS floors deliberately trade unbounded
+    small-graph padding for a single shared tiny-graph bucket."""
+    if n_class <= MIN_NODE_CLASS or e_class <= MIN_EDGE_CLASS:
+        return None
+    n_min = min_member(n_class, MIN_NODE_CLASS)
+    e_min = min_member(e_class, MIN_EDGE_CLASS)
+    payload = bucket_bytes(n_min, e_min)
+    return (bucket_bytes(n_class, e_class) - payload) / payload
+
+
+def _grid_pad_fractions(grid: Sequence[int]) -> List[Tuple[int, float]]:
+    """(class, worst pad fraction) per non-floor class of an explicit
+    1-D ladder (the fixture-mode ``grid`` override): waste measured on
+    edge-slot bytes between consecutive classes."""
+    out = []
+    for prev, cur in zip(grid, grid[1:]):
+        payload = bucket_bytes(MIN_NODE_CLASS, prev + 1)
+        waste = (bucket_bytes(MIN_NODE_CLASS, cur) - payload) / payload
+        out.append((cur, waste))
+    return out
+
+
+def max_pad_fraction(spec: SurfaceSpec) -> float:
+    if spec.grid is not None:
+        fracs = [w for _, w in _grid_pad_fractions(spec.grid)]
+    else:
+        fracs = [f for n, e in surface_buckets(spec)
+                 if (f := pad_fraction(n, e)) is not None]
+    return max(fracs, default=0.0)
+
+
+def check_padding(spec: SurfaceSpec) -> List[Diagnostic]:
+    diags = []
+    if spec.grid is not None:
+        worst = [(f"e{c}", w) for c, w in _grid_pad_fractions(spec.grid)
+                 if w > spec.pad_waste_frac]
+    else:
+        worst = [(f"n{n}_e{e}", f) for n, e in surface_buckets(spec)
+                 if (f := pad_fraction(n, e)) is not None
+                 and f > spec.pad_waste_frac]
+    for key, frac in worst[:8]:    # cap the flood; one is already fatal
+        diags.append(Diagnostic(
+            rule="padding-waste", file=spec.origin, line=spec.origin_line,
+            message=f"bucket {key}: worst-case member pads "
+                    f"{frac:.0%} of its payload "
+                    f"(> {spec.pad_waste_frac:.0%}): the ladder's "
+                    f"class spacing broke the {{2^k, 3*2^k}} waste "
+                    f"bound (~50%)"))
+    return diags
+
+
+# --------------------------------------------------------------------
+# Liveness sweep (needs a traced jaxpr; jax itself only for tracing)
+# --------------------------------------------------------------------
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    dt = getattr(aval, "dtype", None)
+    try:
+        import numpy as np
+
+        item = np.dtype(dt).itemsize
+    except TypeError:
+        # extended dtypes (typed PRNG keys): key<fry> = 2 x uint32
+        item = getattr(dt, "itemsize", 8)
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * int(item)
+
+
+def _sub_jaxprs(eqn) -> Iterable:
+    for v in eqn.params.values():
+        if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for el in v:
+                if hasattr(el, "eqns") or hasattr(el, "jaxpr"):
+                    yield el
+
+
+def peak_live_bytes(jaxpr, donated: FrozenSet[int] = frozenset()
+                    ) -> Dict[str, int]:
+    """Liveness sweep over a (Closed)Jaxpr: ``{"peak", "arg_bytes",
+    "out_bytes"}`` in bytes.
+
+    The model: a non-donated input buffer is live for the whole
+    execution (XLA preserves it); a donated one dies at its last use;
+    every other value is born at its defining equation and dies after
+    its last use; program outputs live to the end.  A primitive
+    equation's execution moment holds its live set plus its outputs
+    being materialized; a call/control-flow equation (pjit, while, cond,
+    scan) holds the live set *minus its operands* plus the recursive
+    peak of its worst sub-jaxpr (operands alias the callee's inputs —
+    counted once, inside).  Fusion can only shrink this, so the result
+    is a conservative ceiling on the executable's live HBM.
+    """
+    from jax.core import Var
+
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    eqns = inner.eqns
+    end = len(eqns)
+    last_use: Dict = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if isinstance(v, Var):
+                last_use[v] = i
+    for v in inner.outvars:
+        if isinstance(v, Var):
+            last_use[v] = end
+    for i, v in enumerate(inner.invars):
+        if i not in donated:
+            last_use[v] = end
+    for v in inner.constvars:
+        last_use[v] = end
+
+    live: Dict = {}
+    for v in list(inner.invars) + list(inner.constvars):
+        live[v] = _aval_bytes(v.aval)
+    cur = sum(live.values())
+    peak = cur
+    for i, eqn in enumerate(eqns):
+        subs = list(_sub_jaxprs(eqn))
+        out_bytes = sum(_aval_bytes(v.aval) for v in eqn.outvars)
+        if subs:
+            operands = {v for v in eqn.invars if isinstance(v, Var)}
+            op_bytes = sum(live.get(v, 0) for v in operands)
+            # callee inputs may all be reused inside (XLA aliases the
+            # call frame), so they die at their inner last use
+            inner_peak = max(
+                peak_live_bytes(
+                    s, donated=frozenset(
+                        range(len(getattr(s, "jaxpr", s).invars))))["peak"]
+                for s in subs)
+            exec_bytes = cur - op_bytes + \
+                max(inner_peak, op_bytes + out_bytes)
+        else:
+            exec_bytes = cur + out_bytes
+        peak = max(peak, exec_bytes)
+        for v in eqn.outvars:
+            b = _aval_bytes(v.aval)
+            live[v] = b
+            cur += b
+        for v in [u for u in live if last_use.get(u, -1) <= i]:
+            cur -= live.pop(v)
+    return {"peak": peak,
+            "arg_bytes": sum(_aval_bytes(v.aval) for v in inner.invars),
+            "out_bytes": sum(_aval_bytes(v.aval) for v in inner.outvars)}
+
+
+# --------------------------------------------------------------------
+# The traced surface model
+# --------------------------------------------------------------------
+
+
+def _trace_peak(kind: str, n_class: int, e_class: int, b: int, mode: str,
+                spec: SurfaceSpec) -> Dict[str, int]:
+    """Trace one surface executable (analysis/entrypoints.py owns the
+    operand construction) and sweep it.  Memoized per process — the
+    ceiling search and the gate revisit buckets."""
+    return _trace_peak_cached(kind, n_class, e_class, b, mode,
+                              spec.n_p, spec.algorithm)
+
+
+def _trace_peak_cached(kind, n_class, e_class, b, mode, n_p, algorithm):
+    import logging
+
+    key = (kind, n_class, e_class, b, mode, n_p, algorithm)
+    try:
+        return _TRACE_CACHE[key]
+    except KeyError:
+        pass
+    from fastconsensus_tpu.analysis import entrypoints as eps
+
+    logger = logging.getLogger("fastconsensus_tpu")
+    level = logger.level
+    logger.setLevel(logging.ERROR)   # hash-cap warnings are expected at
+    try:                             # frontier shapes; keep CI logs clean
+        closed = eps.trace_serving_executable(
+            kind, n_class, e_class, b=b, mode=mode, n_p=n_p,
+            algorithm=algorithm)
+    finally:
+        logger.setLevel(level)
+    res = peak_live_bytes(closed)
+    _TRACE_CACHE[key] = res
+    return res
+
+
+_TRACE_CACHE: Dict[Tuple, Dict[str, int]] = {}
+
+
+def _max_reachable_e(n_class: int, spec: SurfaceSpec) -> Optional[int]:
+    cands = [e for e in edge_classes(spec) if reachable(n_class, e, spec)]
+    return max(cands, default=None)
+
+
+def _rep_kinds(spec: SurfaceSpec) -> List[Tuple[str, int, str]]:
+    """The executable families the gate scans with: the warm batch
+    block AND the batched final detect at the top rung — the committed
+    r08 artifact shows detect-batch is the worst kind at the binding
+    bucket, so a block-only scan would let an over-budget detect-batch
+    at a non-winner bucket escape.  (The block's cold/scratch siblings
+    model within a percent of warm — the scan winners get every kind
+    priced exactly.)  Solo equivalents when batching is off."""
+    top = batch_rungs(spec.max_batch)[-1]
+    if top > 1:
+        return [("batch", top, "warm"), ("detect-batch", top, "-")]
+    return [("rounds", 1, "warm"), ("detect", 1, "-")]
+
+
+def _worst_n_rows(e_class: int, spec: SurfaceSpec) -> List[int]:
+    """The node classes the gate prices per edge class: the
+    densest-connected posture (n = 2e — every edge touches two nodes)
+    and the isolated-node-padded posture (n = max_nodes; admissible at
+    ANY edge count, and the detector hash tables scale with n).
+    Interior node classes can locally exceed both when the detector's
+    chunk estimate steps, but by at most one chunk-budget quantum —
+    documented model tolerance."""
+    rows = {grid_up(min(2 * e_class, spec.max_nodes), MIN_NODE_CLASS),
+            grid_up(spec.max_nodes, MIN_NODE_CLASS)}
+    return sorted(n for n in rows if reachable(n, e_class, spec))
+
+
+def scan_rows(spec: SurfaceSpec,
+              stop_over_budget: int = 0) -> List[Dict]:
+    """Representative-kind peaks per (edge class x worst node row),
+    ascending in edge class.  ``stop_over_budget`` > 0 stops the scan
+    after that many over-budget rows (the gate only needs existence; a
+    deliberately tiny CI budget must fail fast, not trace the ladder)."""
+    rows: List[Dict] = []
+    over = 0
+    for e_class in edge_classes(spec):
+        for n_class in _worst_n_rows(e_class, spec):
+            for kind, b, mode in _rep_kinds(spec):
+                res = _trace_peak(kind, n_class, e_class, b, mode, spec)
+                rows.append({"kind": kind,
+                             "bucket": f"n{n_class}_e{e_class}",
+                             "n_class": n_class, "e_class": e_class,
+                             "batch": b, "mode": mode,
+                             "peak_bytes": res["peak"],
+                             "arg_bytes": res["arg_bytes"],
+                             "out_bytes": res["out_bytes"]})
+                if res["peak"] > spec.hbm_bytes:
+                    over += 1
+                    if stop_over_budget and over >= stop_over_budget:
+                        return rows
+    return rows
+
+
+def _all_kind_rows(n_class: int, e_class: int, spec: SurfaceSpec
+                   ) -> List[Dict]:
+    """Every executable kind this bucket compiles, priced exactly."""
+    rows: List[Dict] = []
+    top_rung = batch_rungs(spec.max_batch)[-1]
+    for kind, b, mode in (
+            [("rounds", 1, "warm"), ("rounds", 1, "scratch"),
+             ("tail", 1, "-"), ("detect", 1, "-")] +
+            [("batch", top_rung, m) for m in BATCH_MODES
+             if top_rung > 1] +
+            ([("detect-batch", top_rung, "-")] if top_rung > 1 else [])):
+        res = _trace_peak(kind, n_class, e_class, b, mode, spec)
+        rows.append({"kind": kind, "bucket": f"n{n_class}_e{e_class}",
+                     "n_class": n_class, "e_class": e_class,
+                     "batch": b, "mode": mode,
+                     "peak_bytes": res["peak"],
+                     "arg_bytes": res["arg_bytes"],
+                     "out_bytes": res["out_bytes"]})
+    return rows
+
+
+def _matmul_frontier(spec: SurfaceSpec) -> Optional[Tuple[int, int]]:
+    """Largest matmul-path bucket (the lowering flips at MATMUL_MAX_N
+    nodes, so this regime needs its own probe)."""
+    ns = [n for n in node_classes(spec) if n <= MATMUL_MAX_N]
+    if not ns:
+        return None
+    e = _max_reachable_e(max(ns), spec)
+    return None if e is None else (max(ns), e)
+
+
+def check_peak_bytes(spec: SurfaceSpec
+                     ) -> Tuple[List[Diagnostic], List[Dict]]:
+    """The jaxpr-peak-bytes gate: scan the ladder's worst node rows
+    with the dominant kind, then price every kind at the scan winners
+    and the matmul frontier."""
+    MAX_FINDINGS = 6
+    scanned = scan_rows(spec, stop_over_budget=4)
+    winners = sorted(scanned, key=lambda r: -r["peak_bytes"])[:2]
+    gate_rows: List[Dict] = list(scanned)
+    seen: set = set()
+    full_at = [(r["n_class"], r["e_class"]) for r in winners]
+    mm = _matmul_frontier(spec)
+    if mm is not None and mm not in full_at:
+        full_at.append(mm)
+    for n_class, e_class in full_at:
+        if (n_class, e_class) in seen:
+            continue
+        seen.add((n_class, e_class))
+        for row in _all_kind_rows(n_class, e_class, spec):
+            if not any(r["bucket"] == row["bucket"]
+                       and r["kind"] == row["kind"]
+                       and r["mode"] == row["mode"] for r in gate_rows):
+                gate_rows.append(row)
+    diags: List[Diagnostic] = []
+    for r in gate_rows:
+        if r["peak_bytes"] > spec.hbm_bytes and len(diags) < MAX_FINDINGS:
+            diags.append(Diagnostic(
+                rule="jaxpr-peak-bytes", file=spec.origin,
+                line=spec.origin_line,
+                message=f"surface executable {r['kind']} at bucket "
+                        f"{r['bucket']} (B={r['batch']}, "
+                        f"mode={r['mode']}) models a peak of "
+                        f"{r['peak_bytes']:,} live device bytes > "
+                        f"the per-chip budget {spec.hbm_bytes:,} "
+                        f"(--hbm-bytes): it OOMs on first traffic "
+                        f"unless kept off-chip (--chip-max-edges / "
+                        f"--max-nodes admission)"))
+    return diags, gate_rows
+
+
+def footprint_table(spec: SurfaceSpec,
+                    max_rows: int = 12) -> List[Dict]:
+    """The per-bucket footprint table (the report/artifact ``buckets``
+    block): the e-spine sampled at power-of-two classes (plus the ladder
+    floor and top), each bucket at its worst-case node class, modeling
+    the batched block at the top rung plus the solo rounds block."""
+    es = edge_classes(spec)
+    spine = [e for e in es if e & (e - 1) == 0]   # powers of two
+    for must in (es[0], es[-1]):
+        if must not in spine:
+            spine.append(must)
+    spine = sorted(set(spine))
+    if len(spine) > max_rows:                     # thin evenly, keep ends
+        idx = {0, len(spine) - 1}
+        step = (len(spine) - 1) / (max_rows - 1)
+        idx |= {round(i * step) for i in range(max_rows)}
+        spine = [spine[i] for i in sorted(idx)]
+    rows: List[Dict] = []
+    top_rung = batch_rungs(spec.max_batch)[-1]
+    for e_class in spine:
+        n_class = grid_up(min(2 * e_class, spec.max_nodes),
+                          MIN_NODE_CLASS)
+        if not reachable(n_class, e_class, spec):
+            continue
+        batch = _trace_peak("batch" if top_rung > 1 else "rounds",
+                            n_class, e_class, top_rung,
+                            "warm", spec)
+        solo = _trace_peak("rounds", n_class, e_class, 1, "warm", spec)
+        pad = pad_fraction(n_class, e_class)
+        rows.append({
+            "bucket": f"n{n_class}_e{e_class}",
+            "n_class": n_class, "e_class": e_class,
+            "capacity": bucket_capacity(e_class), "batch": top_rung,
+            "peak_bytes": batch["peak"],
+            "solo_peak_bytes": solo["peak"],
+            "arg_bytes": batch["arg_bytes"],
+            "out_bytes": batch["out_bytes"],
+            "pad_frac": round(pad, 4) if pad is not None else None,
+        })
+    return rows
+
+
+def derive_chip_ceiling(hbm_bytes: Optional[int] = None,
+                        spec: Optional[SurfaceSpec] = None
+                        ) -> Optional[int]:
+    """The largest ladder edge class E such that EVERY edge class up to
+    E fits ``hbm_bytes`` on one chip — what ``serve --chip-max-edges
+    auto`` routes on, and the startup validator for ``--warm`` specs.
+
+    Routing is by edge class only (serve/pool.py ``_is_huge``), so the
+    ceiling must be a *prefix* property: the scan walks the ladder
+    ascending and stops at the first edge class whose worst-case
+    executable no longer fits (peaks are not monotone in bucket size —
+    see :func:`check_peak_bytes` — so a binary search would lie).
+
+    Worst case per edge class: the densest-connected posture
+    ``n_class = grid_up(min(2 * e_class, max_nodes))`` at the top batch
+    rung, across BOTH batched executables the bucket compiles — the
+    rounds block and the batched final detect, whichever models bigger
+    (the committed r08 artifact shows detect-batch IS the worst kind at
+    the binding bucket, so pricing only the block would admit a bucket
+    whose first batched job still OOMs).  A graph declaring far MORE
+    isolated nodes than 2e is priced by the jaxpr-peak-bytes gate's
+    ``n = max_nodes`` row and governed by ``--max-nodes`` admission —
+    an edge ceiling cannot bound node-dominated padding, and pretending
+    it could would derive a ceiling of "nothing fits" for every posture
+    that admits million-node graphs.  The model prices the spec's
+    ensemble width (``n_p`` — serve resolves it from the warm config);
+    requests free to choose a much larger ``n_p`` scale past it.
+    Returns None when not even the floor bucket fits (the budget cannot
+    serve this posture at all).
+    """
+    spec = spec or SurfaceSpec()
+    if hbm_bytes is None:
+        hbm_bytes = spec.hbm_bytes
+    kinds = _rep_kinds(spec)
+    ceiling: Optional[int] = None
+    for e_class in edge_classes(spec):
+        n_class = grid_up(min(2 * e_class, spec.max_nodes),
+                          MIN_NODE_CLASS)
+        if not reachable(n_class, e_class, spec):
+            continue
+        peak = max(_trace_peak(k, n_class, e_class, b, m, spec)["peak"]
+                   for k, b, m in kinds)
+        if peak > hbm_bytes:
+            break
+        ceiling = e_class
+    return ceiling
+
+
+# --------------------------------------------------------------------
+# Orchestration (what __main__ calls)
+# --------------------------------------------------------------------
+
+
+def evaluate(spec: SurfaceSpec, rules: Optional[Iterable[str]] = None,
+             with_table: bool = False, with_ceiling: bool = False
+             ) -> Tuple[List[Diagnostic], Dict]:
+    """Run the selected footprint rules against one posture; returns
+    (diagnostics, footprint report block — see the module docstring
+    schema).  ``jaxpr-peak-bytes`` is the only rule that imports jax."""
+    selected = set(rules) if rules is not None else set(FOOTPRINT_RULES)
+    selected &= {r for r in FOOTPRINT_RULES if spec.wants(r)}
+    diags: List[Diagnostic] = []
+    block: Dict = {
+        "tool": "fcheck-footprint",
+        "version": 1,
+        "config": {
+            "max_nodes": spec.max_nodes, "max_edges": spec.max_edges,
+            "max_batch": spec.max_batch, "n_p": spec.n_p,
+            "algorithm": spec.algorithm, "hbm_bytes": spec.hbm_bytes,
+            "surface_budget": spec.surface_budget,
+            "pad_waste_frac": spec.pad_waste_frac,
+        },
+        "surface_count": surface_count(spec),
+        "surface_budget": spec.surface_budget,
+        "max_pad_frac": round(max_pad_fraction(spec), 4),
+        "chip_ceiling_edges": None,
+        "gate": [],
+        "buckets": [],
+    }
+    if "surface-count" in selected:
+        diags.extend(check_surface(spec))
+    if "padding-waste" in selected:
+        diags.extend(check_padding(spec))
+    if "jaxpr-peak-bytes" in selected:
+        peak_diags, gate_rows = check_peak_bytes(spec)
+        diags.extend(peak_diags)
+        block["gate"] = gate_rows
+        if with_ceiling:
+            block["chip_ceiling_edges"] = derive_chip_ceiling(
+                spec.hbm_bytes, spec)
+        if with_table:
+            block["buckets"] = footprint_table(spec)
+    return diags, block

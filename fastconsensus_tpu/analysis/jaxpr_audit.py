@@ -114,14 +114,24 @@ def audit_jaxpr(closed_jaxpr, name: str,
 
 
 def audit_entry_points(names: Optional[List[str]] = None,
-                       gather_threshold: int = 1 << 26
+                       gather_threshold: int = 1 << 26,
+                       hbm_bytes: Optional[int] = None
                        ) -> Tuple[List[Diagnostic], Dict[str, Dict[str, int]]]:
     """Trace + audit every registered entry point (or the named subset).
 
     A failure to trace at all is itself a diagnostic (``trace-error``):
     the canonical shapes are the contract the jitted surface must keep.
+
+    Each entry point's summary also carries its liveness-sweep
+    ``peak_bytes`` (analysis/footprint.py) — at the registry's canonical
+    small shapes this is observability (drift in the peak is the memory
+    analog of primitive-count drift), and with ``hbm_bytes`` set any
+    entry point modeling past the budget is a ``jaxpr-peak-bytes``
+    finding (the serving-surface gate at real bucket shapes lives in
+    the footprint pass).
     """
     from fastconsensus_tpu.analysis import entrypoints as eps
+    from fastconsensus_tpu.analysis.footprint import peak_live_bytes
 
     diags: List[Diagnostic] = []
     summary: Dict[str, Dict[str, int]] = {}
@@ -139,5 +149,13 @@ def audit_entry_points(names: Optional[List[str]] = None,
         d, hist = audit_jaxpr(closed, ep.name,
                               gather_threshold=gather_threshold)
         diags.extend(d)
+        peak = peak_live_bytes(closed)["peak"]
+        hist["peak_bytes"] = peak
+        if hbm_bytes is not None and peak > hbm_bytes:
+            diags.append(Diagnostic(
+                rule="jaxpr-peak-bytes", file=ep.name,
+                message=f"{ep.name} models {peak:,} peak live device "
+                        f"bytes at its CANONICAL (small) shapes > the "
+                        f"per-chip budget {hbm_bytes:,} (--hbm-bytes)"))
         summary[ep.name] = hist
     return diags, summary

@@ -27,6 +27,13 @@ reader:
   rerun (e.g. ``runs/bench_emailEu_rerun.json``, a transport-degraded
   probe) must not fail CI forever.
 
+The fcheck-footprint artifacts (``runs/footprint_rNN.json``, written by
+``python -m fastconsensus_tpu.analysis --footprint-out``) ride the same
+reader: :func:`load_footprints` / :func:`footprint_table` render the
+serving memory model's trend (executable surface, chip ceiling, worst
+peak, padding) and :func:`check_footprints` gates on silent surface
+growth between committed rounds.
+
 ``scripts/bench_report.py`` is the CLI; ``scripts/ci_check.sh`` runs it
 with ``--check`` as a gate.
 """
@@ -196,23 +203,9 @@ def trend_table(groups: Dict[str, List[dict]],
     """Per-config trend report over the normalized history."""
     lines: List[str] = []
     for config, recs in groups.items():
-        header = [h for _, h in _COLUMNS]
-        rows = [[_fmt(r[k]) for k, _ in _COLUMNS] for r in recs]
-        if markdown:
-            lines.append(f"### {config}")
-            lines.append("| " + " | ".join(header) + " |")
-            lines.append("|" + "|".join("---" for _ in header) + "|")
-            lines.extend("| " + " | ".join(row) + " |" for row in rows)
-        else:
-            lines.append(f"== {config} ==")
-            widths = [max(len(header[i]), *(len(r[i]) for r in rows))
-                      for i in range(len(header))]
-            lines.append("  ".join(h.ljust(w)
-                                   for h, w in zip(header, widths)))
-            for row in rows:
-                lines.append("  ".join(c.ljust(w)
-                                       for c, w in zip(row, widths)))
-        lines.append("")
+        lines += _render_rows(config, [h for _, h in _COLUMNS],
+                              [[_fmt(r[k]) for k, _ in _COLUMNS]
+                               for r in recs], markdown)
     return "\n".join(lines).rstrip() or "(no bench records found)"
 
 
@@ -240,23 +233,130 @@ def device_table(groups: Dict[str, List[dict]],
                          _fmt(d.get("busy_s")),
                          _fmt(d.get("busy_frac")),
                          "yes" if d.get("cordoned") else "no"])
-        title = f"{config} devices [{newest['source']}]"
-        if markdown:
-            lines.append(f"### {title}")
-            lines.append("| " + " | ".join(header) + " |")
-            lines.append("|" + "|".join("---" for _ in header) + "|")
-            lines.extend("| " + " | ".join(row) + " |" for row in rows)
-        else:
-            lines.append(f"== {title} ==")
-            widths = [max(len(header[i]), *(len(r[i]) for r in rows))
-                      for i in range(len(header))]
-            lines.append("  ".join(h.ljust(w)
-                                   for h, w in zip(header, widths)))
-            for row in rows:
-                lines.append("  ".join(c.ljust(w)
-                                       for c, w in zip(row, widths)))
-        lines.append("")
+        lines += _render_rows(f"{config} devices [{newest['source']}]",
+                              header, rows, markdown)
     return "\n".join(lines).rstrip()
+
+
+def load_footprints(paths: List[str]) -> List[dict]:
+    """fcheck-footprint artifacts (``runs/footprint_rNN.json`` — the
+    schema analysis/footprint.py documents), normalized and ordered by
+    round sequence; files that are not footprint artifacts are skipped
+    silently, mirroring :func:`load_records`."""
+    out = []
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(doc, dict) or \
+                doc.get("tool") != "fcheck-footprint":
+            continue
+        gate = doc.get("gate") or []
+        worst = max(gate, key=lambda r: r.get("peak_bytes", 0),
+                    default=None)
+        out.append({
+            "source": os.path.basename(path),
+            "seq": _seq_from_name(path),
+            "surface_count": doc.get("surface_count"),
+            "surface_budget": doc.get("surface_budget"),
+            "chip_ceiling_edges": doc.get("chip_ceiling_edges"),
+            "max_pad_frac": doc.get("max_pad_frac"),
+            "hbm_bytes": (doc.get("config") or {}).get("hbm_bytes"),
+            "worst_peak_bytes": (worst or {}).get("peak_bytes"),
+            "worst_bucket": (worst or {}).get("bucket"),
+            "buckets": doc.get("buckets") or [],
+        })
+    out.sort(key=lambda r: (r["seq"] is not None, r["seq"] or 0,
+                            r["source"]))
+    return out
+
+
+def _render_rows(title: str, header: List[str], rows: List[List[str]],
+                 markdown: bool) -> List[str]:
+    lines: List[str] = []
+    if markdown:
+        lines.append(f"### {title}")
+        lines.append("| " + " | ".join(header) + " |")
+        lines.append("|" + "|".join("---" for _ in header) + "|")
+        lines.extend("| " + " | ".join(row) + " |" for row in rows)
+    else:
+        lines.append(f"== {title} ==")
+        widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+                  for i in range(len(header))]
+        lines.append("  ".join(h.ljust(w)
+                               for h, w in zip(header, widths)))
+        for row in rows:
+            lines.append("  ".join(c.ljust(w)
+                                   for c, w in zip(row, widths)))
+    lines.append("")
+    return lines
+
+
+def _gib(v) -> str:
+    return "-" if v is None else f"{v / (1 << 30):.2f}"
+
+
+def footprint_table(fps: List[dict], markdown: bool = False) -> str:
+    """Trend + per-bucket footprint tables: the executable-surface and
+    padding columns of the serving memory model.  Empty string when no
+    footprint artifact is committed."""
+    if not fps:
+        return ""
+    lines = _render_rows(
+        "fcheck-footprint trend",
+        ["seq", "source", "surface", "budget", "ceiling_edges",
+         "worst_peak_gib", "worst_bucket", "max_pad"],
+        [[_fmt(f["seq"]), f["source"], _fmt(f["surface_count"]),
+          _fmt(f["surface_budget"]), _fmt(f["chip_ceiling_edges"]),
+          _gib(f["worst_peak_bytes"]), _fmt(f["worst_bucket"]),
+          _fmt(f["max_pad_frac"])] for f in fps],
+        markdown)
+    newest = fps[-1]
+    if newest["buckets"]:
+        lines += _render_rows(
+            f"footprint buckets [{newest['source']}]",
+            ["bucket", "batch", "peak_gib", "solo_gib", "arg_mib",
+             "pad_frac"],
+            [[b["bucket"], _fmt(b.get("batch")),
+              _gib(b.get("peak_bytes")), _gib(b.get("solo_peak_bytes")),
+              "-" if b.get("arg_bytes") is None
+              else f"{b['arg_bytes'] / (1 << 20):.1f}",
+              _fmt(b.get("pad_frac"))] for b in newest["buckets"]],
+            markdown)
+    return "\n".join(lines).rstrip()
+
+
+def check_footprints(fps: List[dict]) -> List[str]:
+    """Footprint regression findings: the newest sequenced artifact's
+    executable surface grew versus the prior committed one (a silent
+    static-axis or ladder expansion — deliberate growth should raise
+    footprint.SURFACE_BUDGET_DEFAULT with a rationale in the same
+    change), or its surface breached its own pinned budget."""
+    problems: List[str] = []
+    seqd = [f for f in fps if f["seq"] is not None
+            and f["surface_count"] is not None]
+    if not seqd:
+        return problems
+    newest = seqd[-1]
+    tag = f"footprint [{newest['source']} seq {newest['seq']}]"
+    prior = [f for f in seqd if f["seq"] < newest["seq"]]
+    if prior:
+        base = prior[-1]
+        if newest["surface_count"] > base["surface_count"]:
+            problems.append(
+                f"{tag}: executable surface grew "
+                f"{base['surface_count']} -> {newest['surface_count']} "
+                f"vs {base['source']} — every extra executable is a "
+                f"compile the fleet pays per bucket; if deliberate, "
+                f"raise the pinned surface budget in the same change")
+    if newest["surface_budget"] is not None and \
+            newest["surface_count"] > newest["surface_budget"]:
+        problems.append(
+            f"{tag}: surface {newest['surface_count']} exceeds its own "
+            f"pinned budget {newest['surface_budget']}")
+    return problems
 
 
 def check_history(groups: Dict[str, List[dict]],

@@ -81,11 +81,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "the huge tier (graphs past --chip-max-edges run "
                         "edge-sharded across it; default "
                         f"{d.huge_devices} = tier off)")
-    p.add_argument("--chip-max-edges", type=int, default=d.chip_max_edges,
-                   metavar="E",
+    p.add_argument("--chip-max-edges", default=d.chip_max_edges,
+                   metavar="E|auto",
                    help="single-chip bucket ceiling: buckets with edge "
                         "class > E route to the huge tier (requires "
-                        "--huge-devices >= 1)")
+                        "--huge-devices >= 1).  'auto' derives the "
+                        "largest ladder bucket whose executables fit "
+                        "--hbm-bytes from the fcheck-footprint memory "
+                        "model (analysis/footprint.py), priced at the "
+                        "--warm-config ensemble width (default n_p 20)")
+    p.add_argument("--hbm-bytes", type=int, default=None, metavar="BYTES",
+                   help="per-chip device-memory budget for "
+                        "'--chip-max-edges auto' and for validating an "
+                        "explicit ceiling at startup (default: the "
+                        "local device's advertised memory, else the "
+                        "model's synthetic CI budget)")
     p.add_argument("--spill-backlog", type=int, default=d.spill_backlog,
                    metavar="J",
                    help="sticky-affinity spill threshold: a bucket's "
@@ -102,6 +112,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quiet", action="store_true",
                    help="suppress startup/drain log lines")
     return p
+
+
+def _device_hbm_bytes() -> Optional[int]:
+    """The local accelerator's advertised memory, when it advertises one
+    (CPU backends do not — callers fall back to the model's synthetic
+    budget)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        return int(stats["bytes_limit"]) if stats else None
+    except Exception:  # noqa: BLE001 — absent stats are a normal backend
+        return None
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -133,16 +156,75 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.max_batch < 1:
         print("error: --max-batch must be >= 1", file=sys.stderr)
         return 2
-    if args.chip_max_edges is not None and args.huge_devices < 1:
+    chip_max_edges = args.chip_max_edges
+    if isinstance(chip_max_edges, str):
+        if chip_max_edges.lower() == "auto":
+            chip_max_edges = "auto"
+        else:
+            try:
+                chip_max_edges = int(chip_max_edges)
+            except ValueError:
+                print(f"error: --chip-max-edges {chip_max_edges!r}: "
+                      f"expected an integer or 'auto'", file=sys.stderr)
+                return 2
+    if chip_max_edges is not None and args.huge_devices < 1:
         print("error: --chip-max-edges needs --huge-devices >= 1 (the "
               "huge tier is what runs graphs past the ceiling)",
               file=sys.stderr)
         return 2
-    if args.huge_devices >= 1 and args.chip_max_edges is None:
+    if args.huge_devices >= 1 and chip_max_edges is None:
         print("error: --huge-devices without --chip-max-edges reserves "
               "a mesh group no bucket can ever route to; set the "
               "single-chip ceiling too", file=sys.stderr)
         return 2
+    if chip_max_edges == "auto" or (chip_max_edges is not None
+                                    and args.hbm_bytes is not None):
+        # the fcheck-footprint memory model: derive the largest ladder
+        # bucket whose worst-case executable set fits one chip, and
+        # hold an explicit ceiling to the same standard (failing fast
+        # at startup beats OOM-ing on first traffic)
+        from fastconsensus_tpu.analysis import footprint
+
+        budget = args.hbm_bytes
+        if budget is None:
+            budget = _device_hbm_bytes() or footprint.CHIP_HBM_BYTES_DEFAULT
+        spec = footprint.SurfaceSpec(
+            max_nodes=args.max_nodes, max_edges=args.max_edges,
+            max_batch=args.max_batch,
+            n_p=int((warm_config or {}).get("n_p", 20)),
+            algorithm=str((warm_config or {}).get("algorithm",
+                                                  "louvain")))
+        say(f"deriving the single-chip ceiling from the footprint "
+            f"model (budget {budget / 2**30:.1f} GiB)...")
+        derived = footprint.derive_chip_ceiling(budget, spec)
+        if derived is None:
+            print(f"error: no ladder bucket fits --hbm-bytes {budget} "
+                  f"under this posture; lower --max-nodes/--max-batch "
+                  f"or raise the budget", file=sys.stderr)
+            return 2
+        if chip_max_edges == "auto":
+            if derived >= footprint.grid_up(
+                    args.max_edges, footprint.MIN_EDGE_CLASS):
+                # the whole admissible ladder fits one chip, so nothing
+                # would ever route to the mandatory huge tier — the
+                # same idle-mesh-group misconfiguration the explicit
+                # validation above exits 2 on, reached via auto
+                print(f"error: --chip-max-edges auto derived {derived} "
+                      f"edges, which covers every admissible bucket "
+                      f"(--max-edges {args.max_edges}); the reserved "
+                      f"--huge-devices group would idle forever — drop "
+                      f"the huge tier, raise --max-edges, or set an "
+                      f"explicit lower ceiling", file=sys.stderr)
+                return 2
+            chip_max_edges = derived
+            say(f"--chip-max-edges auto -> {derived} edges")
+        elif chip_max_edges > derived:
+            print(f"error: --chip-max-edges {chip_max_edges} exceeds "
+                  f"the derived single-chip ceiling {derived} for "
+                  f"--hbm-bytes {budget}: buckets between them would "
+                  f"OOM on first traffic (footprint model)",
+                  file=sys.stderr)
+            return 2
     cfg = ServeConfig(queue_depth=args.queue_depth,
                       cache_entries=args.cache_entries,
                       cache_ttl_s=args.cache_ttl,
@@ -157,7 +239,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                       prewarm_config=warm_config,
                       devices=args.devices,
                       huge_devices=args.huge_devices,
-                      chip_max_edges=args.chip_max_edges,
+                      chip_max_edges=chip_max_edges,
                       spill_backlog=args.spill_backlog)
     try:
         service = ConsensusService(cfg).start()

@@ -158,6 +158,44 @@ class ServeConfig:
     spill_backlog: int = 8
 
 
+def validate_warm_specs(config: ServeConfig) -> None:
+    """Fail fast on ``--warm`` specs the running server could never use.
+
+    Checked at server START (raises ValueError -> the CLI exits 2)
+    rather than logged at warm time, because each bad shape silently
+    wastes multi-minute compiles or pre-warms executables no request
+    can reach: a malformed/off-grid key, a rung < 1, a bucket outside
+    the admission bounds (no request can ever land there), or a bucket
+    past the single-chip ceiling — the mesh tier serves those SOLO and
+    edge-sharded, so a single-chip ladder pre-warm for them compiles
+    executables the scheduler will never route a job to.
+    """
+    from fastconsensus_tpu import sizing
+
+    n_cap = sizing.grid_up(config.max_nodes, bucketer.MIN_NODE_CLASS)
+    e_cap = sizing.grid_up(config.max_edges, bucketer.MIN_EDGE_CLASS)
+    for spec in config.prewarm:
+        key, _, b = spec.partition(":")
+        if b and (not b.isdigit() or int(b) < 1):
+            raise ValueError(
+                f"--warm {spec!r}: rung must be an integer >= 1")
+        bucket = bucketer.bucket_from_key(key)   # off-grid -> ValueError
+        if bucket.n_class > n_cap or bucket.e_class > e_cap:
+            raise ValueError(
+                f"--warm {spec!r}: bucket {bucket.key()} is outside the "
+                f"admission bounds (max_nodes={config.max_nodes}, "
+                f"max_edges={config.max_edges} admit buckets up to "
+                f"n{n_cap}_e{e_cap}); no request can ever land in it")
+        if config.chip_max_edges is not None and \
+                bucket.e_class > config.chip_max_edges:
+            raise ValueError(
+                f"--warm {spec!r}: bucket {bucket.key()} exceeds the "
+                f"single-chip ceiling ({config.chip_max_edges} edges); "
+                f"its traffic routes to the mesh tier, which runs solo "
+                f"edge-sharded executables — a single-chip ladder "
+                f"pre-warm there compiles executables no job will hit")
+
+
 class ConsensusService:
     """The queue -> bucket -> cache -> ``run_consensus`` pipeline."""
 
@@ -184,9 +222,15 @@ class ConsensusService:
     # -- lifecycle ---------------------------------------------------
 
     def start(self) -> "ConsensusService":
-        """Build the device worker pool and launch it (idempotent)."""
+        """Build the device worker pool and launch it (idempotent).
+
+        Raises ValueError on a config the server could never serve
+        correctly — including ``--warm`` specs past the admission
+        bounds or the single-chip ceiling (fail at start, not as an
+        OOM or a wasted compile on first traffic)."""
         if self.pool is not None:
             return self
+        validate_warm_specs(self.config)
         if self.config.pin_sizing:
             os.environ.setdefault("FCTPU_DETECT_CALL_MEMBERS", "0")
             os.environ.setdefault("FCTPU_ROUNDS_BLOCK", "8")

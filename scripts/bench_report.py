@@ -48,6 +48,11 @@ def default_paths() -> List[str]:
         sorted(glob.glob(os.path.join(REPO, "runs", "bench_*.json")))
 
 
+def default_footprint_paths() -> List[str]:
+    return sorted(glob.glob(os.path.join(REPO, "runs",
+                                         "footprint_r*.json")))
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="scripts/bench_report.py",
@@ -82,6 +87,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("no bench records found in "
               f"{len(paths)} file(s)", file=sys.stderr)
         return 2
+    # the serving memory model's artifacts ride the same report: with
+    # explicit paths, whatever footprint artifacts those paths contain;
+    # by default, the committed runs/footprint_r*.json history
+    footprints = history.load_footprints(
+        args.paths or default_footprint_paths())
     if not args.quiet:
         print(history.trend_table(groups, markdown=args.markdown))
         devices = history.device_table(groups, markdown=args.markdown)
@@ -91,11 +101,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             # the report answers "which chips did the work"
             print()
             print(devices)
+        fp_table = history.footprint_table(footprints,
+                                           markdown=args.markdown)
+        if fp_table:
+            print()
+            print(fp_table)
     if not args.check:
         return 0
     problems = history.check_history(groups,
                                      max_drop_frac=args.max_drop_frac,
                                      nmi_drop=args.nmi_drop)
+    problems += history.check_footprints(footprints)
     n_recs = sum(len(r) for r in groups.values())
     if problems:
         print(f"\nbench_report: {len(problems)} regression finding(s) "

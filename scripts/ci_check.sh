@@ -19,6 +19,61 @@ if [ $rc -ne 0 ]; then
     exit $rc
 fi
 
+echo "== fcheck-footprint: memory & surface gate (report-driven) =="
+# satellite contract: this stage CONSUMES the --json report the gate
+# above already wrote (documented schema in analysis/footprint.py)
+# instead of scraping stdout
+python - "$REPORT" <<'PYEOF'
+import json
+import sys
+
+blob = json.load(open(sys.argv[1]))
+fp = blob.get("footprint")
+assert fp, "fcheck report carries no footprint block"
+assert fp["tool"] == "fcheck-footprint" and fp["version"] == 1, fp
+assert fp["surface_count"] <= fp["surface_budget"], \
+    (fp["surface_count"], fp["surface_budget"])
+assert fp["chip_ceiling_edges"], fp
+assert fp["gate"] and fp["buckets"], "footprint table is empty"
+worst = max(fp["gate"], key=lambda r: r["peak_bytes"])
+budget = fp["config"]["hbm_bytes"]
+assert worst["peak_bytes"] <= budget, (worst, budget)
+print(f"footprint gate ok: surface {fp['surface_count']}/"
+      f"{fp['surface_budget']} executables, worst peak "
+      f"{worst['peak_bytes']/2**30:.2f} GiB ({worst['kind']} at "
+      f"{worst['bucket']}) <= {budget/2**30:.0f} GiB, chip ceiling "
+      f"{fp['chip_ceiling_edges']} edges")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "footprint block in $REPORT failed its pins (exit $rc)" >&2
+    exit 1
+fi
+# a deliberately tiny HBM budget must FAIL naming jaxpr-peak-bytes;
+# --no-jaxpr skips the 26-entry-point audit (whose canonical-shape
+# diagnostics could satisfy the grep on their own) so this probe pins
+# the FOOTPRINT scan path specifically — and early-stops, staying fast
+out=$(JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
+    fastconsensus_tpu/ --no-jaxpr --only jaxpr-peak-bytes \
+    --hbm-bytes 1000000 2>&1)
+rc=$?
+if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "\[jaxpr-peak-bytes\]"; then
+    echo "tiny --hbm-bytes exited $rc without naming jaxpr-peak-bytes:" >&2
+    echo "$out" >&2
+    exit 1
+fi
+# ...and so must a tiny surface budget (pure grid math, no jax)
+out=$(JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
+    fastconsensus_tpu/ --no-jaxpr --only surface-count \
+    --surface-budget 10 2>&1)
+rc=$?
+if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "\[surface-count\]"; then
+    echo "tiny --surface-budget exited $rc without naming surface-count:" >&2
+    echo "$out" >&2
+    exit 1
+fi
+echo "footprint negative probes ok: tiny budgets fail naming their rule"
+
 echo "== fcheck: violating fixtures must still be caught =="
 # guards against the analyzer silently going blind (a no-op analyzer
 # would pass the gate above forever); exit 1 means "found violations" —
@@ -32,16 +87,20 @@ if [ "$fixture_rc" -ne 1 ]; then
     exit 1
 fi
 
-echo "== fcheck-concurrency: each bad_ fixture must fail with ITS rule =="
-# the concurrency pass is whole-program; running each violating fixture
-# alone pins that the right rule (not a neighbor) catches it, and that
-# the analyzer names the rule id in its output
+echo "== fcheck: each bad_ fixture must fail with ITS rule =="
+# the concurrency pass is whole-program and the footprint rules are
+# posture-driven (FOOTPRINT_SPEC fixtures); running each violating
+# fixture alone pins that the right rule (not a neighbor) catches it,
+# and that the analyzer names the rule id in its output
 for pair in \
     bad_guarded_field.py:guarded-field \
     bad_lock_order.py:lock-order \
     bad_blocking_lock.py:blocking-under-lock \
     bad_notify_outside.py:notify-outside-lock \
-    bad_root_write.py:unguarded-root-write
+    bad_root_write.py:unguarded-root-write \
+    bad_surface_budget.py:surface-count \
+    bad_padding_ladder.py:padding-waste \
+    bad_footprint_budget.py:jaxpr-peak-bytes
 do
     fixture="${pair%%:*}"
     rule="${pair##*:}"
@@ -59,7 +118,7 @@ do
         exit 1
     fi
 done
-echo "concurrency fixtures: all 5 rules fire with their ids"
+echo "fixtures: all 8 rules fire with their ids"
 
 echo "== fcheck-concurrency: pool stress under the lock-order recorder =="
 # ISSUE 7 acceptance: the recorder run over the pool stress reports an
@@ -466,6 +525,84 @@ if [ $rc -ne 0 ]; then
     echo "fcpool drain-time trace lacks per-device tracks (exit $rc)" >&2
     exit $rc
 fi
+
+echo "== fcserve: footprint-derived ceiling (--chip-max-edges auto) =="
+# a ceiling-crossing --warm spec must be REJECTED at startup (exit 2,
+# fail fast) instead of compiling single-chip executables the scheduler
+# would only ever route to the mesh tier
+WARM_OUT=$(JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    timeout -k 10 120 python -m fastconsensus_tpu.serve \
+    --devices 3 --huge-devices 1 --chip-max-edges 64 \
+    --warm n64_e96 --port 0 2>&1)
+rc=$?
+if [ "$rc" -ne 2 ] || ! printf '%s' "$WARM_OUT" | grep -q "mesh tier"; then
+    echo "ceiling-crossing --warm spec was not rejected at start" \
+         "(exit $rc):" >&2
+    echo "$WARM_OUT" >&2
+    exit 1
+fi
+echo "ceiling-crossing --warm spec rejected at startup (exit 2)"
+# --chip-max-edges auto: the server derives the ceiling from the
+# footprint model at startup (small admission bounds keep the ladder
+# scan to a few traces) and serves end-to-end under it
+AUTO_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR" "$SERVE_DIR" "$BATCH_DIR" "$POOL_DIR" "$AUTO_DIR"; [ -n "$SERVE_PID" ] && kill -9 "$SERVE_PID" 2>/dev/null' EXIT
+AUTO_PORT=$(python - <<'PYEOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+PYEOF
+)
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python -m fastconsensus_tpu.serve --host 127.0.0.1 \
+    --port "$AUTO_PORT" --devices 3 --huge-devices 1 \
+    --chip-max-edges auto --hbm-bytes $((256*1024*1024)) \
+    --max-nodes 4096 --max-edges 1024 2> "$AUTO_DIR/serve.log" &
+SERVE_PID=$!
+JAX_PLATFORMS=cpu python - "$AUTO_PORT" <<'PYEOF'
+import sys
+import time
+
+from fastconsensus_tpu.serve.client import ServeClient
+from fastconsensus_tpu.utils.io import read_edgelist
+
+client = ServeClient(f"http://127.0.0.1:{int(sys.argv[1])}", timeout=30.0)
+for _ in range(600):   # jax import + the startup ladder scan
+    try:
+        client.healthz()
+        break
+    except Exception:
+        time.sleep(0.2)
+else:
+    sys.exit("fcserve (auto ceiling) never came up")
+edges, _, ids = read_edgelist("examples/karate_club.txt")
+sub = client.submit(edges=edges.tolist(), n_nodes=len(ids),
+                    algorithm="lpm", n_p=4, delta=0.1, max_rounds=2,
+                    seed=1)
+res = client.wait(sub["job_id"], timeout=300)
+assert res.get("partitions"), res
+assert res.get("tier") == "chip", res   # under the ceiling: single chip
+print("auto-ceiling smoke ok: job served end-to-end under the derived "
+      "ceiling")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcserve auto-ceiling smoke failed (exit $rc)" >&2
+    cat "$AUTO_DIR/serve.log" >&2
+    exit $rc
+fi
+kill -TERM "$SERVE_PID"
+wait "$SERVE_PID"
+SERVE_PID=""
+if ! grep -q "chip-max-edges auto ->" "$AUTO_DIR/serve.log"; then
+    echo "server log never announced the derived ceiling:" >&2
+    cat "$AUTO_DIR/serve.log" >&2
+    exit 1
+fi
+grep "chip-max-edges auto ->" "$AUTO_DIR/serve.log"
 
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"

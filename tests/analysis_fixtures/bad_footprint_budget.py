@@ -1,0 +1,15 @@
+"""Violating fixture for the ``jaxpr-peak-bytes`` rule: a serving
+posture whose frontier executables cannot fit the declared per-chip
+budget — 200 KB of HBM serves nothing, and the analyzer must say so at
+review time instead of OOM-ing on first traffic.  The surface is kept
+tiny (max 256 nodes / 512 edges, batch 2) so the rule's trace probes
+stay fast in CI."""
+
+FOOTPRINT_SPEC = {
+    "max_nodes": 256,
+    "max_edges": 512,
+    "max_batch": 2,
+    "n_p": 4,
+    "hbm_bytes": 200_000,
+    "rules": ["jaxpr-peak-bytes"],
+}

@@ -1,0 +1,9 @@
+"""Violating fixture for the ``padding-waste`` rule: an edge ladder
+with a 128 -> 1024 gap, so a 129-edge graph pads ~6x its payload — the
+broken-grid geometry the rule trips on (the real {2^k, 3*2^k} ladder
+bounds worst-case padding under 50%).  Pure grid math: no jax."""
+
+FOOTPRINT_SPEC = {
+    "grid": [64, 96, 128, 1024],
+    "rules": ["padding-waste"],
+}

@@ -1,0 +1,10 @@
+"""Violating fixture for the ``surface-count`` rule: the full default
+ladder (2^20 nodes x 2^22 edges x batch rungs x engine modes) against a
+10-executable budget — the cartesian static-arg explosion the rule
+exists to catch at review time, before CompileGuard catches it at
+runtime.  Pure grid math: no jax import, no traces."""
+
+FOOTPRINT_SPEC = {
+    "surface_budget": 10,
+    "rules": ["surface-count"],
+}

@@ -1,0 +1,12 @@
+"""Clean counterpart of bad_footprint_budget.py: the same tiny serving
+surface under a budget (1 GB) its frontier executables comfortably fit —
+the rule must stay silent."""
+
+FOOTPRINT_SPEC = {
+    "max_nodes": 256,
+    "max_edges": 512,
+    "max_batch": 2,
+    "n_p": 4,
+    "hbm_bytes": 1_000_000_000,
+    "rules": ["jaxpr-peak-bytes"],
+}

@@ -1,0 +1,8 @@
+"""Clean counterpart of bad_padding_ladder.py: a gapless {2^k, 3*2^k}
+ladder prefix whose worst-case member padding stays under the threshold
+— the rule must stay silent."""
+
+FOOTPRINT_SPEC = {
+    "grid": [64, 96, 128, 192, 256, 384, 512],
+    "rules": ["padding-waste"],
+}

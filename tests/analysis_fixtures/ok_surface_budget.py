@@ -1,0 +1,7 @@
+"""Clean counterpart of bad_surface_budget.py: the same default ladder
+under a budget with room to spare — the rule must stay silent."""
+
+FOOTPRINT_SPEC = {
+    "surface_budget": 1_000_000,
+    "rules": ["surface-count"],
+}

@@ -1,0 +1,282 @@
+"""fcheck-footprint: liveness sweep, ladder mirrors, surface/padding
+rules, fixture postures, the derived chip ceiling, and the serve-side
+warm-spec validation that rides on it."""
+
+import os
+
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+
+
+# -- jax-free half: grid mirrors, enumeration, padding -----------------
+
+
+def test_grid_mirror_matches_sizing():
+    """footprint.py mirrors sizing.grid_up / bucketer / graph sizing
+    locally (the pre-commit hook must not import jax); the mirrors must
+    track the real functions exactly."""
+    from fastconsensus_tpu import sizing
+    from fastconsensus_tpu.analysis import footprint as fp
+    from fastconsensus_tpu.serve import bucketer
+
+    for v in list(range(1, 600)) + [4095, 4096, 4097, 1 << 20,
+                                    (1 << 20) + 1, 3 << 19]:
+        assert fp.grid_up(v) == sizing.grid_up(v), v
+        assert fp.grid_up(v, 64) == sizing.grid_up(v, 64), v
+    for e in (64, 96, 313, 5000):
+        b = bucketer.bucket_for(64, e)
+        assert fp.bucket_capacity(b.e_class) == b.capacity
+        assert fp.bucket_agg_cap(b.e_class) == b.agg_cap
+    assert fp.BATCH_RUNGS == bucketer.BATCH_LADDER
+    assert fp.MIN_NODE_CLASS == bucketer.MIN_NODE_CLASS
+    assert fp.MIN_EDGE_CLASS == bucketer.MIN_EDGE_CLASS
+    from fastconsensus_tpu.models.louvain import MATMUL_MAX_N
+
+    assert fp.MATMUL_MAX_N == MATMUL_MAX_N
+
+
+def test_surface_spec_mirrors_serve_defaults():
+    """The default posture must be the one ServeConfig actually serves —
+    a drifted mirror would gate a surface nobody runs."""
+    from fastconsensus_tpu.analysis import footprint as fp
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.server import ServeConfig
+
+    spec, cfg = fp.SurfaceSpec(), ServeConfig()
+    assert spec.max_nodes == cfg.max_nodes
+    assert spec.max_edges == cfg.max_edges
+    assert spec.max_batch == cfg.max_batch
+    assert spec.n_p == ConsensusConfig().n_p
+
+
+def test_prev_class_closed_form():
+    from fastconsensus_tpu.analysis import footprint as fp
+
+    for minimum in (1, 64):
+        grid = fp.grid_values(minimum, 1 << 14)
+        for lo, hi in zip(grid, grid[1:]):
+            assert fp.prev_class(hi, minimum) == lo, (minimum, lo, hi)
+        assert fp.prev_class(grid[0], minimum) is None
+
+
+def test_surface_enumeration_and_budget_rule():
+    from fastconsensus_tpu.analysis import footprint as fp
+
+    spec = fp.SurfaceSpec()
+    count = fp.surface_count(spec)
+    # the CI pin: the default posture must fit its own budget with
+    # headroom, and doubling it (a new static axis) must NOT
+    assert count <= fp.SURFACE_BUDGET_DEFAULT < 2 * count
+    assert not fp.check_surface(spec)
+    # an unreachable corner is excluded: 4M edges cannot land on a
+    # 64-node bucket (the complete graph caps at ~2k edges)
+    assert (64, fp.grid_up(spec.max_edges)) not in \
+        fp.surface_buckets(spec)
+    tiny = fp.SurfaceSpec(surface_budget=10)
+    diags = fp.check_surface(tiny)
+    assert len(diags) == 1 and diags[0].rule == "surface-count"
+    assert str(count) in diags[0].message
+
+
+def test_padding_rule_defaults_clean_gaps_fire():
+    from fastconsensus_tpu.analysis import footprint as fp
+
+    spec = fp.SurfaceSpec()
+    # the {2^k, 3*2^k} geometry bounds worst-case waste under 50%
+    assert fp.max_pad_fraction(spec) < 0.5
+    assert not fp.check_padding(spec)
+    # floor buckets are exempt (deliberate floors, unbounded waste)
+    assert fp.pad_fraction(fp.MIN_NODE_CLASS, fp.MIN_EDGE_CLASS) is None
+    gappy = fp.SurfaceSpec(grid=(64, 96, 128, 1024))
+    diags = fp.check_padding(gappy)
+    assert diags and all(d.rule == "padding-waste" for d in diags)
+    assert "e1024" in diags[0].message
+
+
+def test_fixture_specs_fire_their_rule_only():
+    """The bad_/ok_ FOOTPRINT_SPEC fixtures drive each rule in
+    isolation through the same evaluate() path the CLI uses."""
+    from fastconsensus_tpu.analysis import footprint as fp
+
+    def run(name):
+        specs = fp.find_specs([os.path.join(FIXTURES, name)])
+        assert len(specs) == 1, name
+        diags, _ = fp.evaluate(specs[0])
+        return {d.rule for d in diags}
+
+    assert run("bad_surface_budget.py") == {"surface-count"}
+    assert run("ok_surface_budget.py") == set()
+    assert run("bad_padding_ladder.py") == {"padding-waste"}
+    assert run("ok_padding_ladder.py") == set()
+    assert run("bad_footprint_budget.py") == {"jaxpr-peak-bytes"}
+    assert run("ok_footprint_budget.py") == set()
+
+
+def test_find_specs_rejects_junk(tmp_path):
+    from fastconsensus_tpu.analysis import footprint as fp
+
+    (tmp_path / "bad.py").write_text("FOOTPRINT_SPEC = {'no_such': 1}\n")
+    with pytest.raises(ValueError, match="no_such"):
+        fp.find_specs([str(tmp_path)])
+
+
+# -- the liveness sweep ------------------------------------------------
+
+
+def test_peak_live_bytes_known_high_water():
+    """Hand-built jaxpr with a hand-computed high-water mark: x (4 KB,
+    non-donated so pinned for the whole program) + a (4 KB) + b (4 KB)
+    all live while b materializes -> 12 KB; donating x lets it die
+    after its last use -> 8 KB."""
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.analysis.footprint import peak_live_bytes
+
+    def f(x):
+        a = x * 2.0     # x, a live
+        b = a + 1.0     # a dies after; x pinned unless donated
+        return b
+
+    closed = jax.make_jaxpr(f)(jax.ShapeDtypeStruct((1024,), jnp.float32))
+    res = peak_live_bytes(closed)
+    assert res["peak"] == 3 * 4096
+    assert res["arg_bytes"] == 4096 and res["out_bytes"] == 4096
+    assert peak_live_bytes(closed, donated=frozenset({0}))["peak"] \
+        == 2 * 4096
+
+
+def test_peak_live_bytes_recurses_into_calls():
+    """The peak inside a pjit/scan sub-jaxpr must surface: a jitted
+    body materializing a 3x temporary dominates the outer program."""
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.analysis.footprint import peak_live_bytes
+
+    @jax.jit
+    def inner(x):
+        big = jnp.concatenate([x, x, x])    # 3x temp
+        return big.sum()
+
+    closed = jax.make_jaxpr(lambda x: inner(x) + 1.0)(
+        jax.ShapeDtypeStruct((1024,), jnp.float32))
+    res = peak_live_bytes(closed)
+    assert res["peak"] >= 4 * 4096          # x + the 3x concat
+
+
+def test_peak_monotone_along_ladder_within_regime():
+    """The satellite pin: peak bytes are non-decreasing under
+    sizing.grid_up WITHIN one detection-path regime (matmul: n <= 1024;
+    hash above) — the gate's scan exists precisely because the claim is
+    only regime-local (the chunk-budgeted detectors make it false
+    globally; see footprint.check_peak_bytes)."""
+    from fastconsensus_tpu.analysis import footprint as fp
+
+    spec = fp.SurfaceSpec(n_p=4)
+    for regime in (((64, 96), (96, 128), (128, 192)),        # matmul
+                   ((2048, 4096), (3072, 6144), (4096, 8192))):  # hash
+        peaks = [fp._trace_peak("batch", n, e, 2, "warm", spec)["peak"]
+                 for n, e in regime]
+        assert peaks == sorted(peaks), (regime, peaks)
+
+
+# -- the ceiling -------------------------------------------------------
+
+
+def test_derive_chip_ceiling_small_posture():
+    from fastconsensus_tpu.analysis import footprint as fp
+
+    spec = fp.SurfaceSpec(max_nodes=512, max_edges=1024, max_batch=2,
+                          n_p=4)
+    ladder = fp.edge_classes(spec)
+    # a generous budget serves the whole ladder...
+    top = fp.derive_chip_ceiling(1 << 30, spec)
+    assert top == ladder[-1]
+    # ...nothing fits a absurd one...
+    assert fp.derive_chip_ceiling(1000, spec) is None
+    # ...and a budget equal to the floor bucket's own peak admits at
+    # least the floor, lands ON the ladder, and stays monotone in budget
+    floor_peak = fp._trace_peak("batch", fp.grid_up(128, 64),
+                                ladder[0], 2, "warm", spec)["peak"]
+    mid = fp.derive_chip_ceiling(floor_peak, spec)
+    assert mid is not None and mid in ladder
+    assert mid <= top
+
+
+# -- serve integration: warm-spec validation & the auto ceiling --------
+
+
+def test_validate_warm_specs_rejects_bad_postures():
+    from fastconsensus_tpu.serve.server import (ServeConfig,
+                                                validate_warm_specs)
+
+    ok = ServeConfig(prewarm=("n64_e96:4", "n128_e192"))
+    validate_warm_specs(ok)                      # must not raise
+    with pytest.raises(ValueError, match="rung"):
+        validate_warm_specs(ServeConfig(prewarm=("n64_e96:0",)))
+    with pytest.raises(ValueError, match="n<N>_e<E>"):
+        validate_warm_specs(ServeConfig(prewarm=("nonsense",)))
+    with pytest.raises(ValueError, match="ladder grid"):
+        validate_warm_specs(ServeConfig(prewarm=("n65_e96",)))
+    # a bucket no admissible request can reach
+    with pytest.raises(ValueError, match="admission"):
+        validate_warm_specs(ServeConfig(max_edges=64,
+                                        prewarm=("n64_e96",)))
+    # the ceiling-crossing spec: its traffic runs SOLO sharded on the
+    # mesh tier, so the single-chip ladder pre-warm is wasted compiles
+    with pytest.raises(ValueError, match="mesh tier"):
+        validate_warm_specs(ServeConfig(chip_max_edges=64,
+                                        huge_devices=1,
+                                        prewarm=("n64_e96",)))
+
+
+def test_service_start_fails_fast_on_bad_warm_spec():
+    """ConsensusService.start() must raise BEFORE building the pool —
+    the CLI maps this to exit 2 at startup, not a warm-time log line."""
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(max_edges=64,
+                                       prewarm=("n64_e96",)))
+    with pytest.raises(ValueError, match="admission"):
+        svc.start()
+    assert svc.pool is None
+
+
+def test_serve_cli_parses_auto_ceiling():
+    from fastconsensus_tpu.serve.__main__ import build_parser
+
+    args = build_parser().parse_args(["--chip-max-edges", "auto",
+                                      "--huge-devices", "1",
+                                      "--hbm-bytes", "1000000"])
+    assert args.chip_max_edges == "auto"
+    assert args.hbm_bytes == 1000000
+
+
+# -- the report block --------------------------------------------------
+
+
+def test_evaluate_block_schema():
+    """The footprint block the --json report and the
+    runs/footprint_rNN.json artifact carry (the documented schema
+    scripts/bench_report.py consumes)."""
+    from fastconsensus_tpu.analysis import footprint as fp
+
+    spec = fp.SurfaceSpec(max_nodes=256, max_edges=512, max_batch=2,
+                          n_p=4)
+    diags, block = fp.evaluate(spec, with_table=True, with_ceiling=True)
+    assert not diags
+    assert block["tool"] == "fcheck-footprint" and block["version"] == 1
+    assert block["surface_count"] == fp.surface_count(spec)
+    assert block["chip_ceiling_edges"] in fp.edge_classes(spec)
+    assert block["gate"] and block["buckets"]
+    for row in block["buckets"]:
+        assert row["peak_bytes"] >= row["solo_peak_bytes"] > 0
+        assert set(row) >= {"bucket", "batch", "arg_bytes", "out_bytes",
+                            "pad_frac"}
+    # jax-free selection never touches the traced half
+    d2, b2 = fp.evaluate(fp.SurfaceSpec(),
+                         rules=["surface-count", "padding-waste"])
+    assert not d2 and b2["gate"] == [] and b2["buckets"] == []

@@ -167,3 +167,73 @@ def test_bench_report_cli_no_records_is_an_error(tmp_path):
     empty.write_text("{}")
     res = _run_report(str(empty))
     assert res.returncode == 2
+
+
+# ------------------------------------------------- footprint artifacts
+
+def _footprint_artifact(surface=13280, budget=16384, ceiling=4194304,
+                        peak=1 << 30):
+    return {"tool": "fcheck-footprint", "version": 1,
+            "config": {"hbm_bytes": 24 << 30},
+            "surface_count": surface, "surface_budget": budget,
+            "chip_ceiling_edges": ceiling, "max_pad_frac": 0.5,
+            "gate": [{"kind": "batch", "bucket": "n256_e128",
+                      "batch": 8, "mode": "warm", "peak_bytes": peak,
+                      "arg_bytes": 1024, "out_bytes": 512}],
+            "buckets": [{"bucket": "n256_e128", "n_class": 256,
+                         "e_class": 128, "capacity": 272, "batch": 8,
+                         "peak_bytes": peak, "solo_peak_bytes": peak // 8,
+                         "arg_bytes": 1024, "out_bytes": 512,
+                         "pad_frac": 0.31}]}
+
+
+def test_load_footprints_normalizes_and_orders(tmp_path):
+    a = tmp_path / "footprint_r08.json"
+    b = tmp_path / "footprint_r09.json"
+    a.write_text(json.dumps(_footprint_artifact()))
+    b.write_text(json.dumps(_footprint_artifact(surface=13290)))
+    junk = tmp_path / "footprint_rX.json"
+    junk.write_text("{\"tool\": \"something-else\"}")
+    fps = history.load_footprints([str(b), str(junk), str(a)])
+    assert [f["seq"] for f in fps] == [8, 9]
+    assert fps[0]["surface_count"] == 13280
+    assert fps[1]["worst_peak_bytes"] == 1 << 30
+    table = history.footprint_table(fps)
+    assert "fcheck-footprint trend" in table and "n256_e128" in table
+
+
+def test_check_footprints_flags_surface_growth(tmp_path):
+    a = tmp_path / "footprint_r08.json"
+    b = tmp_path / "footprint_r09.json"
+    a.write_text(json.dumps(_footprint_artifact(surface=13280)))
+    b.write_text(json.dumps(_footprint_artifact(surface=14000)))
+    fps = history.load_footprints([str(a), str(b)])
+    problems = history.check_footprints(fps)
+    assert len(problems) == 1 and "13280 -> 14000" in problems[0]
+    # equal or shrinking surface passes
+    b.write_text(json.dumps(_footprint_artifact(surface=13280)))
+    fps = history.load_footprints([str(a), str(b)])
+    assert history.check_footprints(fps) == []
+    # a single committed artifact has no trajectory, but still fails
+    # when it breaches its own pinned budget
+    only = history.load_footprints([str(a)])
+    assert history.check_footprints(only) == []
+    a.write_text(json.dumps(_footprint_artifact(surface=20000)))
+    assert "pinned budget" in history.check_footprints(
+        history.load_footprints([str(a)]))[0]
+
+
+def test_bench_report_cli_gates_footprint_growth(tmp_path):
+    """The CLI wires footprint artifacts into --check when they ride in
+    the explicit paths (and into the trend report)."""
+    bench = _write_series(tmp_path, [60.0, 65.0, 70.0])
+    (tmp_path / "footprint_r08.json").write_text(
+        json.dumps(_footprint_artifact()))
+    (tmp_path / "footprint_r09.json").write_text(
+        json.dumps(_footprint_artifact(surface=14000)))
+    paths = bench + [str(tmp_path / "footprint_r08.json"),
+                     str(tmp_path / "footprint_r09.json")]
+    res = _run_report("--check", *paths)
+    assert res.returncode == 1
+    assert "executable surface grew" in res.stderr
+    assert "fcheck-footprint trend" in res.stdout
