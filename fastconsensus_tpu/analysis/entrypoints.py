@@ -213,7 +213,12 @@ def entry_points() -> List[EntryPoint]:
     # *talks to* jax.profiler (TraceAnnotation wrappers, trace-file
     # merging) but builds no jittable programs, so there is nothing for
     # the jaxpr audit to trace; its host clock reads carry the same
-    # sync-in-loop pragma discipline as the tracer.
+    # sync-in-loop pragma discipline as the tracer — and for the fclat
+    # addition obs/latency.py: stdlib log2-bucket latency histograms and
+    # rate trackers (deliberately jax-free so the report tooling can
+    # load them with jax poisoned), pure host arithmetic with zero
+    # jittable surface; its histogram/registry fields are lock-guarded,
+    # which the concurrency pass (not the jaxpr audit) verifies.
     # The fcserve serving layer (serve/) is host-only by the same
     # reasoning: stdlib HTTP/threading/queue/cache machinery whose only
     # device contact is DRIVING run_consensus — already audited above

@@ -27,6 +27,11 @@ Grown in PR 3 from a host tracer into the full stack:
   (``RoundLog`` round logger, ``phase_span``).
 * **obs/history.py** — normalized ``BENCH_*.json`` history, trend
   report, and the CI regression gate (``scripts/bench_report.py``).
+* **obs/latency.py** — fclat: fixed log2-bucket streaming latency
+  histograms (bounded memory, exact cross-worker merge, p50/p95/p99)
+  plus per-bucket arrival/dispatch rate tracking — the request-
+  lifecycle layer behind ``/metricsz``'s ``latency`` block and the
+  ``bench.py serve_load`` latency-vs-RPS regression gate.
 
 Continuity: counter snapshots persist in checkpoint metadata
 (utils/checkpoint.py) and delta-restore on resume
@@ -44,6 +49,9 @@ from fastconsensus_tpu.obs.counters import (ObsRegistry,  # noqa: F401
                                             device_memory, fold_round,
                                             get_registry, host_sync,
                                             record_device_memory)
+from fastconsensus_tpu.obs.latency import (LatencyHistogram,  # noqa: F401
+                                           LatencyRegistry,
+                                           get_latency_registry)
 from fastconsensus_tpu.obs.roundlog import RoundLog, phase_span  # noqa: F401
 from fastconsensus_tpu.obs.tracer import (Tracer, get_tracer,  # noqa: F401
                                           set_tracer, traced, use_tracer)
@@ -52,5 +60,6 @@ __all__ = [
     "Tracer", "get_tracer", "set_tracer", "use_tracer", "traced",
     "ObsRegistry", "get_registry", "host_sync", "fold_round",
     "device_memory", "record_device_memory",
+    "LatencyHistogram", "LatencyRegistry", "get_latency_registry",
     "RoundLog", "phase_span",
 ]

@@ -50,6 +50,11 @@ class ObsRegistry:
         self._gauges: Dict[str, float] = {}
         self._series: Dict[str, List[float]] = {}
         self._series_limit: Optional[int] = None
+        # samples dropped per series by the window (set_series_limit):
+        # summaries over a truncated series describe the RECENT WINDOW,
+        # not the run — snapshot() must say so (a windowed p95 presented
+        # as a run p95 is how a latency regression hides in /metricsz)
+        self._series_dropped: Dict[str, int] = {}
 
     # -- writes ------------------------------------------------------
 
@@ -66,7 +71,10 @@ class ObsRegistry:
             series = self._series.setdefault(name, [])
             series.append(float(value))
             if self._series_limit and len(series) > self._series_limit:
-                del series[: len(series) - self._series_limit]
+                n_drop = len(series) - self._series_limit
+                del series[:n_drop]
+                self._series_dropped[name] = \
+                    self._series_dropped.get(name, 0) + n_drop
 
     def set_series_limit(self, limit: Optional[int]) -> None:
         """Bound every series to its most recent ``limit`` samples.
@@ -83,14 +91,19 @@ class ObsRegistry:
             self._series_limit = None if limit is None \
                 else max(1, int(limit))
             if self._series_limit:
-                for series in self._series.values():
-                    del series[: len(series) - self._series_limit]
+                for name, series in self._series.items():
+                    n_drop = len(series) - self._series_limit
+                    if n_drop > 0:
+                        del series[:n_drop]
+                        self._series_dropped[name] = \
+                            self._series_dropped.get(name, 0) + n_drop
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
             self._series.clear()
+            self._series_dropped.clear()
 
     def restore_counters(self, saved: Dict[str, int]) -> Dict[str, int]:
         """Restore checkpointed counter totals by *delta*: each counter is
@@ -139,13 +152,23 @@ class ObsRegistry:
             return list(self._series.get(name, ()))
 
     def summary(self, name: str) -> Optional[dict]:
-        """Summary stats of one series, or None if nothing was observed."""
-        values = self.series(name)
+        """Summary stats of one series, or None if nothing was observed.
+
+        When the series window (:meth:`set_series_limit`) has dropped
+        samples, the summary describes the RECENT WINDOW only and says
+        so: ``window_truncated: True`` plus the dropped count — without
+        the stamp, a windowed p95 reads as a run total's p95 (the
+        serving layer's whole-run latency now lives on the fclat
+        histograms in obs/latency.py, which never truncate).
+        """
+        with self._lock:
+            values = list(self._series.get(name, ()))
+            dropped = self._series_dropped.get(name, 0)
         if not values:
             return None
         values.sort()
         total = sum(values)
-        return {
+        out = {
             "count": len(values),
             "total": round(total, 6),
             "mean": round(total / len(values), 6),
@@ -153,6 +176,10 @@ class ObsRegistry:
             "p95": round(percentile(values, 0.95), 6),
             "max": round(values[-1], 6),
         }
+        if dropped:
+            out["window_truncated"] = True
+            out["dropped"] = dropped
+        return out
 
     def snapshot(self) -> dict:
         """One JSON-ready dict of everything (series as summaries)."""

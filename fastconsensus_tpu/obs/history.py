@@ -27,6 +27,16 @@ reader:
   rerun (e.g. ``runs/bench_emailEu_rerun.json``, a transport-degraded
   probe) must not fail CI forever.
 
+The fclat serve_load artifacts (``runs/bench_serve_load_rNN.json``,
+written by ``bench.py serve_load`` — open-loop Poisson latency-vs-RPS
+curves) ride the same reader: records keep their per-RPS curve verbatim
+(``serve_load`` in the normalized record), :func:`serve_load_table`
+renders the latency-vs-RPS view (percentiles, 429 rate, SLO attainment,
+per-phase p95 breakdown) and :func:`check_serve_load` gates tail
+latency at the curve's reference RPS — these artifacts are
+lower-is-better, so :func:`check_history` deliberately skips its
+throughput/NMI rules for them (the warm-compile rule still applies).
+
 The fcheck-footprint artifacts (``runs/footprint_rNN.json``, written by
 ``python -m fastconsensus_tpu.analysis --footprint-out``) ride the same
 reader: :func:`load_footprints` / :func:`footprint_table` render the
@@ -53,6 +63,17 @@ from typing import Dict, List, Optional, Tuple
 # the magnitude the gate exists to catch.
 DEFAULT_MAX_DROP_FRAC = 0.5
 DEFAULT_NMI_DROP = 0.05
+
+# serve_load (fclat latency-curve) gate thresholds: these artifacts are
+# LOWER-IS-BETTER latency curves, so the throughput-drop rule above
+# never applies to them (check_history skips them; check_serve_load
+# owns them).  Growth bounds are loose for the same reason the drop
+# bound is: CPU-CI tail latency is noisy run to run, and the gate
+# exists to catch the 2-10x regressions a queueing bug or a lost
+# coalescing path produces, not scheduler jitter.
+DEFAULT_P95_GROWTH_FRAC = 1.0     # p95 at the reference RPS may double
+DEFAULT_SLO_DROP = 0.15           # absolute attainment drop at ref RPS
+DEFAULT_R429_GROWTH = 0.20        # absolute 429-rate growth at ref RPS
 
 
 def _seq_from_name(path: str) -> Optional[int]:
@@ -118,6 +139,10 @@ def _normalize(rec: dict, source: str, seq: Optional[int]) -> dict:
         # serve/pool.py): per-device jobs/compiles/busy breakdown, kept
         # verbatim for device_table()
         "devices": tel.get("devices") or None,
+        # fclat serve_load artifacts (bench.py serve_load): the whole
+        # per-RPS latency curve, kept verbatim for serve_load_table()
+        # and check_serve_load()
+        "serve_load": tel.get("serve_load") or None,
     }
 
 
@@ -236,6 +261,141 @@ def device_table(groups: Dict[str, List[dict]],
         lines += _render_rows(f"{config} devices [{newest['source']}]",
                               header, rows, markdown)
     return "\n".join(lines).rstrip()
+
+
+_SL_PHASES = ("queue_wait", "dispatch", "deque_wait", "pack", "device",
+              "fanout", "respond")
+
+
+def serve_load_table(groups: Dict[str, List[dict]],
+                     markdown: bool = False) -> str:
+    """Latency-vs-RPS tables for configs whose newest record carries a
+    ``serve_load`` curve (the ``bench.py serve_load`` artifacts): per
+    swept RPS point, the achieved throughput, client-observed and
+    server-side percentiles, the 429/backpressure rate, SLO attainment,
+    and the per-phase p95 breakdown — so a coalescing or admission
+    change shows up as queue-wait movement, not just a throughput
+    scalar.  Empty string when no record has a curve."""
+    header = (["rps", "achieved", "jobs", "429s", "p50_ms", "p95_ms",
+               "p99_ms", "slo_attain"]
+              + [f"{p}_p95" for p in _SL_PHASES])
+    lines: List[str] = []
+    for config, recs in groups.items():
+        newest = next((r for r in reversed(recs)
+                       if r.get("serve_load")), None)
+        if newest is None:
+            continue
+        rows = []
+        for pt in newest["serve_load"].get("points", ()):
+            slo = pt.get("slo") or {}
+            phases = pt.get("phase_p95_ms") or {}
+            rows.append(
+                [_fmt(pt.get("rps")), _fmt(pt.get("achieved_rps")),
+                 _fmt(pt.get("completed"), 0),
+                 _fmt(pt.get("rejected_429"), 0),
+                 _fmt(pt.get("p50_ms")), _fmt(pt.get("p95_ms")),
+                 _fmt(pt.get("p99_ms")), _fmt(slo.get("attainment"))]
+                + [_fmt(phases.get(p)) for p in _SL_PHASES])
+        ref = newest["serve_load"].get("reference_rps")
+        lines += _render_rows(
+            f"{config} latency vs RPS [{newest['source']}; "
+            f"reference rps {_fmt(ref)}]", header, rows, markdown)
+    return "\n".join(lines).rstrip()
+
+
+def _sl_ref_point(rec: dict) -> Optional[dict]:
+    """The record's curve point at its own reference RPS (the gate's
+    anchor — the least-saturated point, where p95 measures the serving
+    path rather than queueing noise)."""
+    sl = rec.get("serve_load") or {}
+    ref = sl.get("reference_rps")
+    for pt in sl.get("points", ()):
+        if pt.get("rps") == ref:
+            return pt
+    return None
+
+
+def _r429_rate(pt: dict) -> Optional[float]:
+    rejected = pt.get("rejected_429")
+    submitted = pt.get("submitted")
+    if rejected is None or not submitted:
+        return None
+    return rejected / submitted
+
+
+def check_serve_load(groups: Dict[str, List[dict]],
+                     p95_growth_frac: float = DEFAULT_P95_GROWTH_FRAC,
+                     slo_drop: float = DEFAULT_SLO_DROP,
+                     r429_growth: float = DEFAULT_R429_GROWTH
+                     ) -> List[str]:
+    """Tail-latency regression findings over serve_load curves; [] means
+    the gate passes.  Per config, the newest sequenced curve is judged
+    at the reference RPS against the median of its sequenced
+    predecessors: p95 growth beyond ``p95_growth_frac``, an SLO
+    attainment drop beyond ``slo_drop`` (absolute), or a 429-rate
+    growth beyond ``r429_growth`` (absolute) is a finding.  One
+    committed curve has no trajectory and passes — the gate arms itself
+    the round after an artifact lands, like check_history."""
+    problems: List[str] = []
+    for config, recs in groups.items():
+        seqd = [r for r in recs if r["seq"] is not None
+                and r.get("serve_load")]
+        if len(seqd) < 2:
+            continue
+        latest_seq = max(r["seq"] for r in seqd)
+        latest = [r for r in seqd if r["seq"] == latest_seq]
+        latest_refs = {(r.get("serve_load") or {}).get("reference_rps")
+                       for r in latest}
+        # compare at the SAME reference RPS only: a sweep whose grid
+        # (and therefore reference point) changed has no prior anchor —
+        # judging its 8-rps p95 against a 2-rps median would
+        # manufacture a "regression" out of ordinary queueing
+        prior = [r for r in seqd if r["seq"] < latest_seq
+                 and (r.get("serve_load") or {}).get("reference_rps")
+                 in latest_refs]
+        prior_pts = [(_sl_ref_point(r), r) for r in prior]
+        prior_p95 = [p["p95_ms"] for p, _ in prior_pts
+                     if p and p.get("p95_ms") is not None]
+        prior_attain = [p["slo"]["attainment"] for p, _ in prior_pts
+                        if p and (p.get("slo") or {}).get("attainment")
+                        is not None]
+        prior_429 = [r for r in (_r429_rate(p) for p, _ in prior_pts
+                                 if p) if r is not None]
+        for r in latest:
+            pt = _sl_ref_point(r)
+            if pt is None:
+                continue
+            tag = f"{config} [{r['source']} seq {r['seq']}]"
+            ref = (r.get("serve_load") or {}).get("reference_rps")
+            if prior_p95 and pt.get("p95_ms") is not None:
+                base = _median(prior_p95)
+                ceil = (1.0 + p95_growth_frac) * base
+                if pt["p95_ms"] > ceil:
+                    problems.append(
+                        f"{tag}: p95 {pt['p95_ms']:.1f} ms at the "
+                        f"reference RPS ({ref}) grew past "
+                        f"{ceil:.1f} ms ({p95_growth_frac:.0%} over "
+                        f"the prior median {base:.1f} ms) — a tail-"
+                        f"latency regression")
+            att = (pt.get("slo") or {}).get("attainment")
+            if prior_attain and att is not None:
+                base = _median(prior_attain)
+                if att < base - slo_drop:
+                    problems.append(
+                        f"{tag}: SLO attainment {att:.3f} at the "
+                        f"reference RPS ({ref}) dropped more than "
+                        f"{slo_drop} below the prior median "
+                        f"{base:.3f}")
+            rate = _r429_rate(pt)
+            if prior_429 and rate is not None:
+                base = _median(prior_429)
+                if rate > base + r429_growth:
+                    problems.append(
+                        f"{tag}: 429 rate {rate:.3f} at the reference "
+                        f"RPS ({ref}) grew more than {r429_growth} "
+                        f"over the prior median {base:.3f} — the "
+                        f"server sheds load it used to serve")
+    return problems
 
 
 def load_footprints(paths: List[str]) -> List[dict]:
@@ -385,6 +545,18 @@ def check_history(groups: Dict[str, List[dict]],
         prior_nmi = [r["nmi"] for r in prior if r["nmi"] is not None]
         for r in latest:
             tag = f"{config} [{r['source']} seq {r['seq']}]"
+            if r.get("serve_load"):
+                # latency-curve artifacts are lower-is-better: the
+                # throughput-drop/NMI rules would gate the WRONG
+                # direction (an improvement would "fail").  The tail-
+                # latency gate (check_serve_load) owns them; the
+                # warm-compile retrace rule still applies below.
+                if (r["compiles_warm"] or 0) > 0:
+                    problems.append(
+                        f"{tag}: {r['compiles_warm']} warm-run "
+                        f"compile(s) — a retrace regression "
+                        f"(telemetry.compiles_warm)")
+                continue
             floor = (1.0 - max_drop_frac) * base_value
             if r["value"] < floor:
                 problems.append(

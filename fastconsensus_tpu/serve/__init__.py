@@ -39,6 +39,7 @@ _EXPORTS = {
     "bucket_for": "bucketer", "pad_to_bucket": "bucketer",
     "ResultCache": "cache",
     "Job": "jobs", "JobSpec": "jobs", "content_hash": "jobs",
+    "SLO_CLASSES": "jobs",
     "AdmissionQueue": "queue", "QueueClosed": "queue",
     "QueueFull": "queue",
     "ConsensusService": "server", "GraphTooLarge": "server",

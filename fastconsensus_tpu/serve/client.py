@@ -61,6 +61,82 @@ class WorkerState:
                    mesh_devices=tuple(w.get("mesh_devices") or ()))
 
 
+@dataclasses.dataclass(frozen=True)
+class JobTiming:
+    """A job's server-side fclat timing block (``/result`` /
+    ``/status`` ``timing``), typed: monotonic-derived milliseconds per
+    phase, the end-to-end latency, and the observed SLO verdict.  The
+    phase names tile the lifetime (queue_wait, dispatch, deque_wait,
+    pack, device, fanout, respond), so ``phase_sum_ms ~= e2e_ms`` —
+    the attribution-consistency contract tests pin server-side."""
+
+    e2e_ms: float
+    phases_ms: Dict[str, float]
+    phase_sum_ms: float
+    slo: str
+    slo_target_ms: float
+    slo_met: bool
+
+    @classmethod
+    def from_payload(cls, t: Dict[str, Any]) -> "JobTiming":
+        return cls(e2e_ms=float(t["e2e_ms"]),
+                   phases_ms={str(k): float(v)
+                              for k, v in t["phases_ms"].items()},
+                   phase_sum_ms=float(t["phase_sum_ms"]),
+                   slo=str(t["slo"]),
+                   slo_target_ms=float(t["slo_target_ms"]),
+                   slo_met=bool(t["slo_met"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class PhaseLatency:
+    """One fclat histogram from ``/metricsz``'s ``latency`` block: a
+    log2-bucketed latency distribution (seconds) for one (name, tags)
+    pair — e.g. ``serve.phase.device`` at bucket n64_e96 / rung 2."""
+
+    name: str
+    tags: Dict[str, str]
+    count: int
+    sum_s: float
+    min_s: Optional[float]
+    max_s: Optional[float]
+    p50_s: Optional[float]
+    p95_s: Optional[float]
+    p99_s: Optional[float]
+    buckets: Dict[str, int]
+
+    @classmethod
+    def from_payload(cls, h: Dict[str, Any]) -> "PhaseLatency":
+        return cls(name=str(h["name"]),
+                   tags={str(k): str(v)
+                         for k, v in (h.get("tags") or {}).items()},
+                   count=int(h["count"]), sum_s=float(h["sum_s"]),
+                   min_s=h.get("min_s"), max_s=h.get("max_s"),
+                   p50_s=h.get("p50_s"), p95_s=h.get("p95_s"),
+                   p99_s=h.get("p99_s"),
+                   buckets={str(k): int(v)
+                            for k, v in (h.get("buckets") or {}).items()})
+
+
+@dataclasses.dataclass(frozen=True)
+class SloStats:
+    """Per-class SLO attainment from ``/metricsz`` (observed, never
+    enforced: ``serve.slo.<class>.met/missed`` folded server-side)."""
+
+    slo_class: str
+    met: int
+    missed: int
+    attainment: float
+    target_default_ms: float
+
+    @classmethod
+    def from_payload(cls, name: str, s: Dict[str, Any]) -> "SloStats":
+        return cls(slo_class=str(name), met=int(s["met"]),
+                   missed=int(s["missed"]),
+                   attainment=float(s["attainment"]),
+                   target_default_ms=float(s["target_default_ms"]))
+
+
 class ServeError(RuntimeError):
     """Non-2xx response; carries the HTTP status and decoded payload."""
 
@@ -157,6 +233,29 @@ class ServeClient:
         """Per-device breakdown from ``/metricsz`` (jobs, batches,
         compiles, busy-fraction, cordon state), keyed by device id."""
         return self.metricsz().get("devices", {})
+
+    def latency(self) -> Dict[str, Any]:
+        """The fclat request-latency view from ``/metricsz``, typed:
+        ``histograms`` ([:class:`PhaseLatency`] — per-phase and
+        end-to-end distributions tagged by bucket/rung/priority/
+        device), ``slo`` ([:class:`SloStats`] per class), and the raw
+        per-bucket ``arrivals`` / ``dispatches`` rate maps."""
+        block = self.metricsz().get("latency", {})
+        return {
+            "histograms": [PhaseLatency.from_payload(h)
+                           for h in block.get("histograms", ())],
+            "slo": [SloStats.from_payload(name, s)
+                    for name, s in sorted(
+                        (block.get("slo") or {}).items())],
+            "arrivals": dict(block.get("arrivals") or {}),
+            "dispatches": dict(block.get("dispatches") or {}),
+        }
+
+    def timing(self, job_id: str) -> Optional[JobTiming]:
+        """A finished job's typed server-side timing block (None while
+        the job is still pending, or for pre-fclat servers)."""
+        t = self.status(job_id).get("timing")
+        return None if t is None else JobTiming.from_payload(t)
 
     def coalescing(self) -> Dict[str, Any]:
         """Operator view of cross-request batching, extracted from

@@ -43,6 +43,38 @@ PRIORITY_NAMES = {
     "batch": PRIORITY_BATCH,
 }
 
+# SLO classes (fclat): per-class end-to-end latency targets in
+# milliseconds.  A job's class defaults from its priority name and can
+# be overridden per request (``slo`` / ``slo_target_ms`` in the submit
+# body).  Attainment is *observed* — counted into ``serve.slo.*`` when
+# the job finishes — never enforced: the counters are the ground truth
+# a future EDF/deadline scheduler will be judged against, so they must
+# exist before it does.
+SLO_CLASSES = {
+    "interactive": 1_000.0,
+    "normal": 10_000.0,
+    "batch": 120_000.0,
+}
+
+# The per-job phase timeline (fclat): each phase closes at the named
+# monotonic stamp, in this order, starting from the admit stamp —
+# phases are CONSECUTIVE DIFFERENCES of one monotonic clock, so the
+# per-job phase sum equals the end-to-end latency by construction
+# (the /metricsz consistency pin in tests/test_latency.py).  A missing
+# stamp (e.g. a cache hit never packs) folds its interval into the next
+# present phase.  The trailing "respond" phase closes at the finished
+# stamp and is computed in Job.timing().
+PHASE_STAMPS: Tuple[Tuple[str, str], ...] = (
+    ("queue_wait", "dispatched"),    # admission heap -> dispatcher pop
+    ("dispatch", "enqueued"),        # routing -> a worker's deque
+    ("deque_wait", "dequeued"),      # parked in the deque -> worker
+    ("pack", "packed"),              # canonicalize + pad to the bucket
+    ("device", "device_done"),       # the consensus device call(s)
+    ("fanout", "fanned_out"),        # slice/recompact/cache-fill
+)
+PHASE_NAMES: Tuple[str, ...] = tuple(
+    [p for p, _ in PHASE_STAMPS] + ["respond"])
+
 # Job lifecycle.  There is deliberately no "rejected" state: admission
 # control (queue full, graph too large, draining) refuses the submission
 # before a Job exists — backpressure is an error the client sees, never
@@ -127,6 +159,29 @@ class JobSpec:
     config: ConsensusConfig
     weights: Optional[np.ndarray] = None
     priority: int = PRIORITY_NORMAL
+    # SLO class (fclat): None derives the class from the priority name.
+    # Deliberately OUTSIDE the content hash (hash_canonical hashes the
+    # config only): the SLO changes what we *promise* about a result,
+    # never the result — distinct SLOs must share one cache entry.
+    slo: Optional[str] = None
+    slo_target_ms: Optional[float] = None
+
+    def slo_class(self) -> str:
+        """The job's SLO class name (``SLO_CLASSES``)."""
+        if self.slo is not None:
+            return self.slo
+        for name, prio in PRIORITY_NAMES.items():
+            if prio == self.priority:
+                return name
+        return "normal"
+
+    def slo_target(self) -> float:
+        """End-to-end target in milliseconds (explicit override, else
+        the class default)."""
+        if self.slo_target_ms is not None:
+            return float(self.slo_target_ms)
+        return SLO_CLASSES.get(self.slo_class(),
+                               SLO_CLASSES["normal"])
 
     def n_edges_raw(self) -> int:
         """Raw (pre-dedupe) edge count — the cheap admission bound."""
@@ -197,9 +252,16 @@ class Job:
         self.key = key if key is not None else spec.content_hash()
         self.job_id = f"j{next(_job_seq):06d}-{self.key[:10]}"
         self.state = STATE_QUEUED
+        # Wall stamps are DISPLAY ONLY (operators correlate them with
+        # logs); every duration derives from the monotonic stamps below
+        # — wall-clock differences skew (or go negative) under NTP
+        # steps, which is exactly when a latency dashboard matters most.
         self.submitted_at = time.time()
         self.started_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # fclat phase timeline: monotonic checkpoints, written through
+        # stamp() as the job crosses each serving stage (PHASE_STAMPS).
+        self._mono: Dict[str, float] = {"admit": time.monotonic()}
         self.error: Optional[str] = None
         self.result: Optional[Dict[str, Any]] = None
         # Cross-request batching metadata (serve/server.py): set when
@@ -236,6 +298,14 @@ class Job:
         with self._lock:
             return self._excluded
 
+    def stamp(self, name: str) -> None:
+        """Record one monotonic phase checkpoint (PHASE_STAMPS names).
+        Re-stamping (a requeued job re-crosses the pipeline) keeps the
+        LATEST time — the timeline then attributes the whole retry to
+        the phases it actually re-ran."""
+        with self._lock:
+            self._mono[name] = time.monotonic()
+
     def mark(self, state: str, result: Optional[Dict[str, Any]] = None,
              error: Optional[str] = None) -> None:
         assert state in STATES, state
@@ -243,21 +313,74 @@ class Job:
             self.state = state
             if state == STATE_RUNNING:
                 self.started_at = time.time()
+                self._mono["started"] = time.monotonic()
             if state in (STATE_DONE, STATE_FAILED):
                 self.finished_at = time.time()
+                self._mono["finished"] = time.monotonic()
             if result is not None:
                 self.result = result
             if error is not None:
                 self.error = error
 
+    def phase_seconds(self) -> Optional[Tuple[Dict[str, float], float]]:
+        """``(phases, e2e)`` in exact (unrounded) monotonic seconds for
+        a finished job, or None before it finishes.  Phases are the
+        consecutive differences of the recorded stamps walked in
+        PHASE_STAMPS order, closed by ``respond`` (last stamp ->
+        finished), so ``sum(phases.values()) == e2e`` up to float
+        addition — the attribution always accounts for the whole
+        lifetime, never double-counts, never leaks an interval.
+        """
+        with self._lock:
+            mono = dict(self._mono)
+        end = mono.get("finished")
+        if end is None:
+            return None
+        admit = mono["admit"]
+        phases: Dict[str, float] = {}
+        prev = admit
+        for phase, stamp_name in PHASE_STAMPS:
+            t = mono.get(stamp_name)
+            if t is None:
+                continue
+            phases[phase] = max(t - prev, 0.0)
+            prev = min(max(t, prev), end)
+        phases["respond"] = max(end - prev, 0.0)
+        return phases, max(end - admit, 0.0)
+
+    def timing(self) -> Optional[Dict[str, Any]]:
+        """JSON-ready server-side timing block for ``/status`` and
+        ``/result`` (milliseconds, monotonic-derived): the per-phase
+        breakdown, the end-to-end latency, and the job's SLO verdict."""
+        ph = self.phase_seconds()
+        if ph is None:
+            return None
+        phases, e2e = ph
+        e2e_ms = e2e * 1000.0
+        target = self.spec.slo_target()
+        return {
+            "e2e_ms": round(e2e_ms, 3),
+            "phases_ms": {k: round(v * 1000.0, 3)
+                          for k, v in phases.items()},
+            "phase_sum_ms": round(sum(phases.values()) * 1000.0, 3),
+            "slo": self.spec.slo_class(),
+            "slo_target_ms": target,
+            "slo_met": bool(e2e_ms <= target),
+        }
+
     def describe(self) -> Dict[str, Any]:
         """JSON-ready status summary (no result payload — that is
-        ``/result``'s job; keeps ``/status`` polls cheap)."""
+        ``/result``'s job; keeps ``/status`` polls cheap).  Wall stamps
+        are for log correlation only; the ``timing`` block (present once
+        the job finishes) carries the monotonic-derived durations."""
+        timing = self.timing()   # takes the lock itself; compute first
         with self._lock:
             return {
                 "job_id": self.job_id,
                 "state": self.state,
                 "priority": self.spec.priority,
+                "slo": self.spec.slo_class(),
+                "slo_target_ms": self.spec.slo_target(),
                 "content_hash": self.key,
                 "n_nodes": self.spec.n_nodes,
                 "algorithm": self.spec.config.algorithm,
@@ -270,4 +393,5 @@ class Job:
                 "device": self.device,
                 "requeues": self.requeues,
                 "excluded_devices": sorted(self._excluded),
+                "timing": timing,
             }

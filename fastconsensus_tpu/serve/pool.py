@@ -127,6 +127,12 @@ class _Worker:
         self._thread.start()
 
     def enqueue(self, batch: List[Job]) -> None:
+        for job in batch:
+            # fclat: the dispatch phase closes when the job lands in a
+            # worker's deque (stamped outside _cond — Job.stamp takes
+            # the job's own lock, and keeping it out of the critical
+            # section keeps _cond covering only the deque)
+            job.stamp("enqueued")
         with self._cond:
             self._batches.append(batch)
             self._cond.notify()
@@ -267,6 +273,11 @@ class _Worker:
             self.pool.note_prewarm_done()
 
     def _run(self, batch: List[Job]) -> None:
+        for job in batch:
+            # fclat: deque_wait closes when the worker thread takes the
+            # batch (after any _coalesce re-merge — ride-alongs merged
+            # from later deque entries stamp here too)
+            job.stamp("dequeued")
         t0 = time.perf_counter()
         try:
             self.service._drain_group(deque(batch), worker=self)
@@ -535,6 +546,9 @@ class WorkerPool:
                 for job in jobs:
                     job.mark(STATE_FAILED, error=str(e))
                     self._reg.inc("serve.jobs.failed")
+                    # an SLO miss, not a gap: during a full cordon the
+                    # attainment counters must crater with the traffic
+                    self.service._record_timeline(job, failed=True)
                 _logger.warning(
                     "fcpool: failed %d job(s) of bucket %s: %s",
                     len(jobs), bucket_key, e)
@@ -545,6 +559,11 @@ class WorkerPool:
         """Re-dispatch a dead worker's unfinished jobs directly (the
         admission queue may already be closed and drained mid-shutdown,
         so requeues never pass through it)."""
+        for job in jobs:
+            # requeues bypass the admission queue's pop, so the fclat
+            # dispatch checkpoint is re-stamped here: the retry's
+            # timeline re-opens at routing, not at a stale first pop
+            job.stamp("dispatched")
         self.dispatch(list(jobs))
 
     # -- the dispatcher ----------------------------------------------

@@ -103,6 +103,10 @@ class AdmissionQueue:
                 if self._heap:
                     _, _, job = heapq.heappop(self._heap)
                     self._reg.gauge("serve.queue.depth", len(self._heap))
+                    # fclat queue_wait closes HERE — the moment the job
+                    # leaves the admission heap (Job.stamp is a leaf
+                    # lock; no cycle with _cond)
+                    job.stamp("dispatched")
                     return job
                 if self._closed:
                     return None
@@ -149,6 +153,11 @@ class AdmissionQueue:
                         if len(taken) > 1:
                             self._reg.inc("serve.queue.coalesced_pops")
                     self._reg.gauge("serve.queue.depth", len(self._heap))
+                    for t in taken:
+                        # queue_wait closes at the coalesced pop for the
+                        # head AND every ride-along (they leave the heap
+                        # together)
+                        t.stamp("dispatched")
                     return taken
                 if self._closed:
                     return None

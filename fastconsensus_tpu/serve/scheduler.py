@@ -42,6 +42,7 @@ import threading
 from typing import Dict, FrozenSet, Optional, Sequence
 
 from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs import latency as obs_latency
 
 
 class NoEligibleWorker(RuntimeError):
@@ -59,6 +60,7 @@ class StickyScheduler:
         self._affinity: Dict[str, int] = {}   # bucket key -> worker idx
         self._lock = threading.Lock()
         self._reg = obs_counters.get_registry()
+        self._lat = obs_latency.get_latency_registry()
 
     def affinity(self) -> Dict[str, int]:
         """Snapshot of the bucket -> home-device map (``/healthz``)."""
@@ -79,6 +81,12 @@ class StickyScheduler:
             raise NoEligibleWorker(
                 f"no eligible worker for bucket {bucket!r} "
                 f"(excluded: {sorted(exclude)})")
+        # fclat dispatch-rate tracking: together with the per-bucket
+        # ARRIVAL rate marked at admission (serve/server.py submit),
+        # this is the signal pair the adaptive hold-for-coalesce window
+        # needs — arrivals/s tells expected time-to-fill a batch rung,
+        # dispatches/s tells how fast the pool is actually draining it.
+        self._lat.dispatches.mark(bucket)
         with self._lock:
             home_idx = self._affinity.get(bucket)
             home = next((w for w in candidates if w.idx == home_idx),

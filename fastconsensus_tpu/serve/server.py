@@ -63,11 +63,13 @@ import numpy as np
 
 from fastconsensus_tpu.cli import ALGORITHMS, DEFAULT_TAU
 from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs import latency as obs_latency
 from fastconsensus_tpu.obs.tracer import get_tracer
 from fastconsensus_tpu.serve import bucketer
 from fastconsensus_tpu.serve.jobs import (PRIORITY_BATCH,
                                           PRIORITY_INTERACTIVE,
                                           PRIORITY_NAMES, PRIORITY_NORMAL,
+                                          SLO_CLASSES,
                                           STATE_DONE, STATE_FAILED,
                                           STATE_QUEUED, STATE_RUNNING, Job,
                                           JobSpec)
@@ -214,6 +216,7 @@ class ConsensusService:
         self._buckets: Dict[str, int] = {}
         self._started_at = time.time()
         self._reg = obs_counters.get_registry()
+        self._lat = obs_latency.get_latency_registry()
         self._batch_seq = itertools.count(1)
         self._prewarm_total = len(self.config.prewarm)
         self._prewarm_done = 0
@@ -360,11 +363,22 @@ class ConsensusService:
                 f"graph has {n_raw} edges; this server admits at most "
                 f"{self.config.max_edges}")
         job = Job(self._normalize_spec(spec))
+        try:
+            # fclat per-bucket arrival rate: offered load, marked for
+            # EVERY admissible request (cache hits included — the
+            # adaptive coalescing window must see the true arrival
+            # process, not the cache-filtered one).  canonical() is
+            # already memoized by the content hash above, so bucket()
+            # is just the grid lookup.
+            self._lat.arrivals.mark(job.spec.bucket().key())
+        except Exception:  # noqa: BLE001 — rate tracking must never
+            pass           # reject a job the bucketer will judge later
         cached = self.cache.get(job.key)
         if cached is not None:
             job.mark(STATE_DONE, result=dict(cached, cached=True))
             self._remember(job)
             self._reg.inc("serve.jobs.cached")
+            self._record_timeline(job, cached=True)
             return job
         try:
             # Pre-compute (memoize) the coalescing group HERE, on the
@@ -416,6 +430,68 @@ class ConsensusService:
                 else:
                     break  # everything retained is live work
 
+    # -- fclat timeline recording -------------------------------------
+
+    def _record_timeline(self, job: Job, rung: int = 1, worker=None,
+                         cached: bool = False,
+                         failed: bool = False) -> None:
+        """Fold one finished job's phase timeline into the fclat
+        histograms (per-phase + end-to-end, tagged by bucket / batch
+        rung / priority / device) and its SLO verdict into the
+        ``serve.slo.*`` attainment counters.  Cache hits record under
+        rung 0 — a genuine serve whose latency profile must not blend
+        into the device-path distributions.  FAILED jobs always count
+        as an SLO miss (a 500 is the worst possible latency from the
+        user's side — attainment must crater during an outage, not
+        read 1.0 off the surviving successes) and record end-to-end
+        only, into ``serve.e2e.failed``, so failure latencies never
+        blend into the served distributions."""
+        ph = job.phase_seconds()
+        if ph is None:
+            return
+        phases, e2e = ph
+        try:
+            bucket_key = job.spec.bucket().key()
+        except Exception:  # noqa: BLE001 — unbucketable specs fail as
+            bucket_key = "-"  # their own job and still count here
+        device = worker.idx if worker is not None else (
+            job.device if job.device is not None else "-")
+        cls = job.spec.slo_class()
+        if failed:
+            self._lat.hist("serve.e2e.failed", bucket=bucket_key,
+                           priority=job.spec.priority).record(e2e)
+            self._reg.inc("serve.slo.missed")
+            self._reg.inc(f"serve.slo.{cls}.missed")
+            return
+        tags = dict(bucket=bucket_key, rung=0 if cached else int(rung),
+                    priority=job.spec.priority, device=device)
+        for name, secs in phases.items():
+            self._lat.hist(f"serve.phase.{name}", **tags).record(secs)
+        self._lat.hist("serve.e2e", **tags).record(e2e)
+        verdict = "met" if e2e * 1000.0 <= job.spec.slo_target() \
+            else "missed"
+        self._reg.inc(f"serve.slo.{verdict}")
+        self._reg.inc(f"serve.slo.{cls}.{verdict}")
+
+    def latency_stats(self) -> Dict[str, Any]:
+        """The ``/metricsz`` ``latency`` block: fclat histogram
+        exposition (per-phase/e2e, JSON form), per-bucket arrival and
+        dispatch rates, and the per-class SLO attainment summary."""
+        snap = self._lat.snapshot()
+        counters = self._reg.counters()
+        slo: Dict[str, Any] = {}
+        for cls, target in SLO_CLASSES.items():
+            met = counters.get(f"serve.slo.{cls}.met", 0)
+            missed = counters.get(f"serve.slo.{cls}.missed", 0)
+            if met or missed:
+                slo[cls] = {
+                    "met": met, "missed": missed,
+                    "attainment": round(met / (met + missed), 4),
+                    "target_default_ms": target,
+                }
+        snap["slo"] = slo
+        return snap
+
     # -- the worker paths (driven by serve/pool.py workers) -----------
 
     def _group_key(self, job: Job) -> str:
@@ -440,6 +516,7 @@ class ConsensusService:
                 # genuine serve, same accounting as the solo re-probe
                 job.mark(STATE_DONE, result=dict(cached, cached=True))
                 self._reg.inc("serve.jobs.completed")
+                self._record_timeline(job, worker=worker, cached=True)
             else:
                 runnable.append(job)
         solo_only = worker is not None and worker.kind == "mesh"
@@ -457,16 +534,21 @@ class ConsensusService:
         if worker is not None:
             job.set_device(worker.idx)
         try:
-            result = self.run_spec(job.spec, key=job.key, worker=worker)
+            result = self.run_spec(job.spec, key=job.key, worker=worker,
+                                   job=job)
+            job.stamp("fanned_out")
             job.mark(STATE_DONE, result=result)
             self._reg.inc("serve.jobs.completed")
             if worker is not None:
                 self._reg.inc(f"serve.device.{worker.idx}.jobs")
+            self._record_timeline(job, rung=1, worker=worker,
+                                  cached=bool(result.get("cached")))
         except Exception as e:  # noqa: BLE001 — one bad job must
             # never take down the worker (and with it every queued
             # job behind it); the failure is the job's result
             job.mark(STATE_FAILED, error=f"{type(e).__name__}: {e}")
             self._reg.inc("serve.jobs.failed")
+            self._record_timeline(job, worker=worker, failed=True)
             _logger.warning("fcserve job %s failed: %s", job.job_id,
                             job.error)
 
@@ -496,9 +578,11 @@ class ConsensusService:
                 job.mark(STATE_FAILED,
                          error=f"{type(e).__name__}: {e}")
                 self._reg.inc("serve.jobs.failed")
+                self._record_timeline(job, worker=worker, failed=True)
                 _logger.warning("fcserve job %s failed at pack: %s",
                                 job.job_id, job.error)
                 continue
+            job.stamp("packed")
             packed.append((job, spec, slab, bucket))
         # pack failures can leave an off-ladder width; re-split so
         # every device call stays on a BATCH_LADDER rung (the
@@ -554,13 +638,17 @@ class ConsensusService:
         # would leave fallback-solo jobs advertising a coalesced run
         # that never happened
         for job, _, _, _ in packed:
+            job.stamp("device_done")
             job.set_batch(batch_id, len(packed))
             if worker is not None:
                 job.set_device(worker.idx)
         self._reg.inc("serve.batch.coalesced")
         self._reg.inc("serve.batch.occupancy", len(packed))
         self._reg.gauge("serve.batch.last_size", len(packed))
-        self._reg.observe("serve.batch.seconds", elapsed)
+        # whole-run latency lives on the fclat histograms (bounded
+        # memory, never window-truncated — obs/latency.py), not the
+        # windowed observe() series the /metricsz footgun was about
+        self._lat.hist("serve.batch.seconds").record(elapsed)
         if worker is not None:
             self._reg.inc(f"serve.device.{worker.idx}.batches")
         for (job, spec, _, _), res in zip(packed, results):
@@ -573,11 +661,14 @@ class ConsensusService:
                     compiles=guard.count, elapsed=elapsed,
                     batch_id=batch_id, batch_size=len(packed),
                     worker=worker)
+            job.stamp("fanned_out")
             job.mark(STATE_DONE, result=result)
             self._reg.inc("serve.jobs.completed")
             if worker is not None:
                 self._reg.inc(f"serve.device.{worker.idx}.jobs")
-            self._reg.observe("serve.job.seconds", elapsed / len(packed))
+            self._lat.hist("serve.job.seconds").record(
+                elapsed / len(packed))
+            self._record_timeline(job, rung=len(packed), worker=worker)
 
     def _finish_result(self, spec: JobSpec, key: str, bucket,
                        partitions_raw, rounds: int, converged: bool,
@@ -712,7 +803,7 @@ class ConsensusService:
             time.perf_counter() - t0)
 
     def run_spec(self, spec: JobSpec, key: Optional[str] = None,
-                 worker=None) -> Dict[str, Any]:
+                 worker=None, job: Optional[Job] = None) -> Dict[str, Any]:
         """Run one spec to a result payload (cache-aware, synchronous).
 
         This is the worker's core, callable directly (tests, embedded
@@ -720,7 +811,9 @@ class ConsensusService:
         registry (``serve.xla_compiles``); a request landing in a warm
         bucket counts zero — the serving contract.  On a mesh worker the
         run executes edge-sharded over the reserved device group
-        (``run_consensus(mesh=...)`` — the huge tier).
+        (``run_consensus(mesh=...)`` — the huge tier).  ``job``, when
+        the call serves one, receives the fclat pack/device phase
+        stamps.
         """
         from fastconsensus_tpu.analysis import CompileGuard
         from fastconsensus_tpu.consensus import run_consensus
@@ -742,6 +835,8 @@ class ConsensusService:
             max_nodes=self.config.max_nodes,
             max_edges=self.config.max_edges,
             canonical=spec.canonical())
+        if job is not None:
+            job.stamp("packed")
         # get_detector is memoized, so every job of one (alg, gamma)
         # shares the detector object jit keys its executables on
         detect = get_detector(spec.config.algorithm,
@@ -757,13 +852,15 @@ class ConsensusService:
             with guard:
                 res = run_consensus(slab, detect, spec.config, mesh=mesh,
                                     n_closure=bucket.n_closure)
+        if job is not None:
+            job.stamp("device_done")
         elapsed = time.perf_counter() - t0
         result = self._finish_result(spec, key, bucket, res.partitions,
                                      rounds=res.rounds,
                                      converged=res.converged,
                                      compiles=guard.count,
                                      elapsed=elapsed, worker=worker)
-        self._reg.observe("serve.job.seconds", elapsed)
+        self._lat.hist("serve.job.seconds").record(elapsed)
         return result
 
     # -- introspection -----------------------------------------------
@@ -902,8 +999,22 @@ def _parse_spec(payload: Dict[str, Any],
             raise ValueError(
                 f"priority {priority} out of range "
                 f"{PRIORITY_INTERACTIVE}..{PRIORITY_BATCH}")
+    slo = payload.get("slo")
+    if slo is not None:
+        slo = str(slo)
+        if slo not in SLO_CLASSES:
+            raise ValueError(
+                f"unknown slo class {slo!r}; one of "
+                f"{', '.join(SLO_CLASSES)}")
+    slo_target_ms = payload.get("slo_target_ms")
+    if slo_target_ms is not None:
+        slo_target_ms = float(slo_target_ms)
+        if not slo_target_ms > 0:
+            raise ValueError(
+                f"slo_target_ms must be > 0, got {slo_target_ms}")
     return JobSpec(edges=edges, n_nodes=n_nodes, config=config,
-                   priority=priority)
+                   priority=priority, slo=slo,
+                   slo_target_ms=slo_target_ms)
 
 
 def _result_json(result: Dict[str, Any]) -> Dict[str, Any]:
@@ -984,7 +1095,8 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/metricsz":
             self._send(200, {"fcobs": self.service._reg.snapshot(),
                              "serve": self.service.stats(),
-                             "devices": self.service.device_stats()})
+                             "devices": self.service.device_stats(),
+                             "latency": self.service.latency_stats()})
             return
         for prefix in ("/status/", "/result/"):
             if path.startswith(prefix):
@@ -995,7 +1107,15 @@ class _Handler(BaseHTTPRequestHandler):
                 if prefix == "/status/":
                     self._send(200, job.describe())
                 elif job.state == STATE_DONE:
-                    self._send(200, _result_json(job.result))
+                    out = _result_json(job.result)
+                    # the timing block is PER SUBMISSION, never cached
+                    # content: two jobs sharing one cached result each
+                    # report their own lifecycle, so it rides the Job,
+                    # not the result payload
+                    timing = job.timing()
+                    if timing is not None:
+                        out["timing"] = timing
+                    self._send(200, out)
                 elif job.state == STATE_FAILED:
                     self._send(500, job.describe())
                 else:

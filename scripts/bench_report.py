@@ -101,6 +101,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             # the report answers "which chips did the work"
             print()
             print(devices)
+        serve_load = history.serve_load_table(groups,
+                                              markdown=args.markdown)
+        if serve_load:
+            # fclat latency-vs-RPS curves (bench.py serve_load): the
+            # per-phase p95 columns are where a coalescing/admission
+            # change shows its mechanism (queue-wait vs device time)
+            print()
+            print(serve_load)
         fp_table = history.footprint_table(footprints,
                                            markdown=args.markdown)
         if fp_table:
@@ -111,6 +119,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     problems = history.check_history(groups,
                                      max_drop_frac=args.max_drop_frac,
                                      nmi_drop=args.nmi_drop)
+    # the fclat tail-latency gate (lower-is-better artifacts the
+    # throughput rule above deliberately skips)
+    problems += history.check_serve_load(groups)
     problems += history.check_footprints(footprints)
     n_recs = sum(len(r) for r in groups.values())
     if problems:

@@ -604,6 +604,61 @@ if ! grep -q "chip-max-edges auto ->" "$AUTO_DIR/serve.log"; then
 fi
 grep "chip-max-edges auto ->" "$AUTO_DIR/serve.log"
 
+echo "== fclat: serve_load smoke (latency curve + tail-latency gate probe) =="
+SL_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR" "$SERVE_DIR" "$BATCH_DIR" "$POOL_DIR" "$AUTO_DIR" "$SL_DIR"; [ -n "$SERVE_PID" ] && kill -9 "$SERVE_PID" 2>/dev/null' EXIT
+# tiny 2-point sweep on karate-sized jobs through a real loopback
+# server; bench.py itself exits non-zero on warm compiles in the timed
+# window or on a per-job phase-sum/e2e divergence > 5% — the fclat
+# acceptance pins ride the scenario's own exit code
+JAX_PLATFORMS=cpu FCTPU_BENCH_CONFIG=serve_load \
+    FCTPU_SERVE_LOAD_RPS="4,8" FCTPU_SERVE_LOAD_SECONDS=3 \
+    FCTPU_SERVE_LOAD_OUT="$SL_DIR/bench_serve_load_smoke.json" \
+    timeout -k 10 600 python bench.py > "$SL_DIR/bench.out"
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "serve_load smoke failed (exit $rc: warm compiles, phase" \
+         "inconsistency, or a stalled point)" >&2
+    cat "$SL_DIR/bench.out" >&2
+    exit 1
+fi
+# the artifact must parse, normalize, and pass the gate next to the
+# committed curve (the smoke artifact is unsequenced, so it informs the
+# table but never gates — exactly the ad-hoc-rerun contract)
+python scripts/bench_report.py --check --quiet \
+    "$SL_DIR/bench_serve_load_smoke.json" runs/bench_serve_load_r09.json
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "bench_report --check failed on the serve_load smoke artifact" \
+         "(exit $rc)" >&2
+    exit 1
+fi
+# negative probe: a synthetically p95-regressed copy one sequence later
+# must FAIL the tail-latency gate (lower-is-better artifacts are judged
+# by check_serve_load, not the throughput rule — a gate that can't fail
+# is no gate)
+python - runs/bench_serve_load_r09.json \
+    "$SL_DIR/bench_serve_load_r99.json" <<'PYEOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+for pt in doc["telemetry"]["serve_load"]["points"]:
+    pt["p95_ms"] = round(pt["p95_ms"] * 10, 3)
+doc["value"] = round(doc["value"] * 10, 3)
+json.dump(doc, open(sys.argv[2], "w"))
+PYEOF
+out=$(python scripts/bench_report.py --check --quiet \
+    runs/bench_serve_load_r09.json "$SL_DIR/bench_serve_load_r99.json" 2>&1)
+rc=$?
+if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "tail-latency"; then
+    echo "p95-regressed serve_load copy did not fail the gate" \
+         "(exit $rc):" >&2
+    echo "$out" >&2
+    exit 1
+fi
+echo "serve_load smoke ok: curve gated, regressed copy fails naming tail-latency"
+
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"
     exit 0

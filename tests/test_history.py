@@ -169,6 +169,119 @@ def test_bench_report_cli_no_records_is_an_error(tmp_path):
     assert res.returncode == 2
 
 
+# ------------------------------------------------ serve_load artifacts
+
+
+def _serve_load_artifact(p95=20.0, attainment=1.0, rejected=0,
+                         ref_rps=2.0):
+    def point(rps, scale):
+        met = int(round(30 * attainment))
+        return {"rps": rps, "seconds": 8.0, "submitted": 30,
+                "completed": 30 - rejected, "failed": 0, "stranded": 0,
+                "rejected_429": rejected, "achieved_rps": rps * 0.97,
+                "p50_ms": round(p95 * scale * 0.6, 3),
+                "p95_ms": round(p95 * scale, 3),
+                "p99_ms": round(p95 * scale * 1.4, 3),
+                "slo": {"met": met, "missed": 30 - met,
+                        "attainment": attainment},
+                "phase_p95_ms": {"queue_wait": 0.5, "deque_wait": 1.0,
+                                 "pack": 2.0, "device": p95 * scale * 0.8,
+                                 "fanout": 0.3, "respond": 0.01},
+                "compiles": 0}
+
+    return {"metric": "serve_load_p95_ms", "config": "serve_load",
+            "value": p95,
+            "unit": f"p95 ms at {ref_rps:g} rps (open-loop poisson, "
+                    f"bucket n64_e96, louvain n_p=4)",
+            "seconds": 32.0, "converged": True, "n_chips": 1,
+            "mesh": "1x1", "backend": "cpu",
+            "telemetry": {"compiles_warm": 0,
+                          "phase_consistency_frac": 0.0,
+                          "serve_load": {"reference_rps": ref_rps,
+                                         "slo_class": "interactive",
+                                         "queue_depth": 32,
+                                         "max_batch": 4,
+                                         "points": [point(ref_rps, 1.0),
+                                                    point(8.0, 2.0)]}}}
+
+
+def _write_serve_load(tmp_path, seq, **over):
+    p = tmp_path / f"bench_serve_load_r{seq:02d}.json"
+    p.write_text(json.dumps(_serve_load_artifact(**over)))
+    return str(p)
+
+
+def test_serve_load_normalizes_and_renders():
+    recs = history.load_records(
+        os.path.join(REPO, "runs", "bench_serve_load_r09.json"))
+    assert len(recs) == 1 and recs[0]["seq"] == 9
+    assert recs[0]["config"] == "serve_load"
+    sl = recs[0]["serve_load"]
+    assert len(sl["points"]) >= 4        # the committed curve shape
+    assert all(p["p95_ms"] is not None for p in sl["points"])
+    groups = history.build_history(
+        [os.path.join(REPO, "runs", "bench_serve_load_r09.json")])
+    table = history.serve_load_table(groups)
+    assert "latency vs RPS" in table
+    assert "deque_wait_p95" in table and "slo_attain" in table
+
+
+def test_check_serve_load_gates_tail_latency(tmp_path):
+    # one committed curve: no trajectory, passes
+    one = [_write_serve_load(tmp_path, 9)]
+    assert history.check_serve_load(history.build_history(one)) == []
+    # stable next round passes; 2x+ p95 growth at the reference RPS fails
+    ok = one + [_write_serve_load(tmp_path, 10, p95=24.0)]
+    assert history.check_serve_load(history.build_history(ok)) == []
+    bad = one + [_write_serve_load(tmp_path, 10, p95=200.0)]
+    probs = history.check_serve_load(history.build_history(bad))
+    assert len(probs) == 1 and "tail-latency" in probs[0]
+    # attainment collapse and 429 growth are their own findings
+    bad = one + [_write_serve_load(tmp_path, 10, attainment=0.5)]
+    probs = history.check_serve_load(history.build_history(bad))
+    assert any("attainment" in p for p in probs)
+    bad = one + [_write_serve_load(tmp_path, 10, rejected=15)]
+    probs = history.check_serve_load(history.build_history(bad))
+    assert any("429" in p for p in probs)
+    # a sweep whose GRID changed has no prior anchor: its higher-RPS
+    # reference point must not be judged against the old low-RPS
+    # median (ordinary queueing would read as a regression)
+    moved = one + [_write_serve_load(tmp_path, 10, p95=200.0,
+                                     ref_rps=8.0)]
+    assert history.check_serve_load(history.build_history(moved)) == []
+
+
+def test_check_history_never_inverts_on_latency_artifacts(tmp_path):
+    """serve_load artifacts are lower-is-better: an IMPROVED (much
+    lower) p95 must not trip the throughput-drop rule, and warm
+    compiles still gate."""
+    paths = [_write_serve_load(tmp_path, 9),
+             _write_serve_load(tmp_path, 10, p95=2.0)]   # 10x better
+    groups = history.build_history(paths)
+    assert history.check_history(groups) == []
+    assert history.check_serve_load(groups) == []
+    art = _serve_load_artifact(p95=20.0)
+    art["telemetry"]["compiles_warm"] = 3
+    (tmp_path / "bench_serve_load_r11.json").write_text(json.dumps(art))
+    groups = history.build_history(
+        paths + [str(tmp_path / "bench_serve_load_r11.json")])
+    probs = history.check_history(groups)
+    assert any("warm-run compile" in p for p in probs)
+
+
+def test_bench_report_cli_gates_serve_load_regression(tmp_path):
+    """The CLI wires check_serve_load into --check and renders the
+    latency-vs-RPS table (the CI negative probe's contract)."""
+    paths = [_write_serve_load(tmp_path, 9),
+             _write_serve_load(tmp_path, 10, p95=200.0)]
+    res = _run_report("--check", *paths)
+    assert res.returncode == 1
+    assert "tail-latency" in res.stderr
+    assert "latency vs RPS" in res.stdout
+    res = _run_report(*[paths[0]])
+    assert res.returncode == 0 and "deque_wait_p95" in res.stdout
+
+
 # ------------------------------------------------- footprint artifacts
 
 def _footprint_artifact(surface=13280, budget=16384, ceiling=4194304,

@@ -1,0 +1,439 @@
+"""fclat request-lifecycle latency layer (obs/latency.py + the serve
+phase timeline): log2-histogram exactness and the cross-worker merge
+property, window-truncation stamping in obs/counters.py, monotonic
+phase math on Jobs, SLO classes, and the loopback phase-sum/e2e
+consistency pin."""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fastconsensus_tpu.obs import latency
+
+
+# -- the log2 histogram ------------------------------------------------
+
+
+def test_bucket_index_boundaries():
+    # exact powers of two land in the bucket whose UPPER edge they are
+    assert latency.bucket_index(1.0) == -latency.MIN_EXP  # 2^0 bucket
+    assert latency.bucket_edge(latency.bucket_index(1.0)) == 1.0
+    assert latency.bucket_edge(latency.bucket_index(0.5)) == 0.5
+    # one past an edge spills into the next bucket
+    assert latency.bucket_index(1.0001) == latency.bucket_index(2.0)
+    # underflow and overflow clamp to the end buckets
+    assert latency.bucket_index(0.0) == 0
+    assert latency.bucket_index(1e-12) == 0
+    assert latency.bucket_edge(latency.bucket_index(1e9)) == math.inf
+
+
+def test_histogram_counts_sums_and_quantiles():
+    h = latency.LatencyHistogram()
+    values = [0.001, 0.002, 0.004, 0.1, 0.5, 1.5]
+    for v in values:
+        h.record(v)
+    s = h.snapshot()
+    assert s["count"] == 6
+    assert s["sum_s"] == pytest.approx(sum(values))
+    assert s["min_s"] == 0.001 and s["max_s"] == 1.5
+    # quantiles are bucket upper edges: conservative, never below the
+    # true value, within 2x of it, and clamped to the exact max
+    assert s["p50_s"] >= 0.004 and s["p50_s"] <= 0.008
+    assert s["p99_s"] == 1.5
+    # empty histogram has no quantiles
+    assert latency.LatencyHistogram().snapshot()["p95_s"] is None
+
+
+def test_exact_merge_across_four_concurrent_writers():
+    """The merge contract: 4 threads each record into their OWN
+    histogram and into one SHARED histogram concurrently; merging the
+    four snapshots must reproduce the shared histogram's buckets,
+    count, and quantiles exactly (sums up to float addition order)."""
+    shared = latency.LatencyHistogram()
+    own = [latency.LatencyHistogram() for _ in range(4)]
+    rngs = [np.random.default_rng(seed) for seed in range(4)]
+
+    def writer(i):
+        for _ in range(2000):
+            v = float(rngs[i].lognormal(mean=-5.0, sigma=2.0))
+            own[i].record(v)
+            shared.record(v)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    merged = latency.merge_snapshots([h.snapshot() for h in own])
+    ref = shared.snapshot()
+    assert merged["count"] == ref["count"] == 8000
+    assert merged["buckets"] == ref["buckets"]
+    assert merged["min_s"] == ref["min_s"]
+    assert merged["max_s"] == ref["max_s"]
+    for q in ("p50_s", "p95_s", "p99_s"):
+        assert merged[q] == ref[q], q
+    assert merged["sum_s"] == pytest.approx(ref["sum_s"])
+
+
+def test_diff_snapshots_attributes_a_window():
+    """diff is merge's inverse: (before, after) snapshots of one
+    histogram yield the histogram of exactly the samples recorded
+    between them — the per-RPS-point attribution bench.py serve_load
+    uses against the process-global registry."""
+    h = latency.LatencyHistogram()
+    for v in (0.001, 0.002):
+        h.record(v)
+    before = h.snapshot()
+    for v in (0.5, 1.5, 3.0):
+        h.record(v)
+    window = latency.diff_snapshots(h.snapshot(), before)
+    assert window["count"] == 3
+    assert window["sum_s"] == pytest.approx(5.0)
+    assert window["p50_s"] >= 0.5        # none of the small pre-window
+    w2 = latency.LatencyHistogram()      # samples leak in
+    for v in (0.5, 1.5, 3.0):
+        w2.record(v)
+    assert window["buckets"] == w2.snapshot()["buckets"]
+
+
+def test_registry_tags_and_text_exposition():
+    reg = latency.LatencyRegistry()
+    reg.hist("serve.phase.device", bucket="n64_e96", rung=2,
+             priority=1, device=0).record(0.03)
+    reg.hist("serve.phase.device", bucket="n64_e96", rung=1,
+             priority=1, device=0).record(0.01)
+    # same (name, tags) -> the same histogram
+    assert reg.hist("serve.phase.device", bucket="n64_e96", rung=2,
+                    priority=1, device=0) is reg.hist(
+        "serve.phase.device", device=0, priority=1, rung=2,
+        bucket="n64_e96")
+    snap = reg.snapshot()
+    assert len(snap["histograms"]) == 2
+    text = latency.render_text(snap)
+    line = next(ln for ln in text.splitlines() if "rung=2" in ln)
+    assert line.startswith("serve.phase.device{")
+    assert "bucket=n64_e96" in line and "count=1" in line
+    assert "p95=0.03" in line
+
+
+def test_rate_tracker_windows_and_decay():
+    tr = latency.RateTracker()
+    for i in range(5):
+        tr.mark("n64_e96", at=float(i))      # 1 arrival/s
+    rates = tr.rates(now=4.0)["n64_e96"]
+    assert rates["count"] == 5 and rates["window"] == 5
+    assert rates["rate_per_s"] == pytest.approx(1.0)
+    # a bucket whose traffic STOPPED must decay toward zero (the
+    # hold-for-coalesce consumer would otherwise hold jobs for phantom
+    # ride-alongs forever), not report the burst rate indefinitely
+    stale = tr.rates(now=4000.0)["n64_e96"]
+    assert stale["rate_per_s"] == pytest.approx(4 / 4000.0)
+    tr.mark("lonely", at=0.0)
+    assert tr.rates(now=10.0)["lonely"]["rate_per_s"] == 0.0
+
+
+# -- the counters window footgun (satellite) ---------------------------
+
+
+def test_series_window_truncation_is_stamped():
+    """A summary over a set_series_limit-truncated series must SAY it
+    describes the recent window (window_truncated + dropped), not
+    present window stats as run totals."""
+    from fastconsensus_tpu.obs.counters import ObsRegistry
+
+    reg = ObsRegistry()
+    for i in range(10):
+        reg.observe("s", float(i))
+    assert "window_truncated" not in reg.summary("s")
+    reg.set_series_limit(4)                  # retroactive trim: 6 drop
+    s = reg.summary("s")
+    assert s["window_truncated"] is True and s["dropped"] == 6
+    reg.observe("s", 10.0)                   # steady-state: 1 more
+    s = reg.summary("s")
+    assert s["dropped"] == 7 and s["count"] == 4
+    assert reg.snapshot()["series"]["s"]["window_truncated"] is True
+    reg.reset()
+    reg.observe("s", 1.0)
+    assert "window_truncated" not in reg.summary("s")
+
+
+# -- Job phase math (monotonic, not wall clock) ------------------------
+
+
+def _job(monkeypatch=None, **spec_over):
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.jobs import Job, JobSpec
+
+    spec = JobSpec(edges=np.array([[0, 1], [1, 2]], dtype=np.int64),
+                   n_nodes=3, config=ConsensusConfig(), **spec_over)
+    return Job(spec, key="k" * 64)
+
+
+def test_durations_survive_wall_clock_steps(monkeypatch):
+    """The satellite contract: wall stamps are display-only; durations
+    derive from time.monotonic, so an NTP step between submit and
+    finish cannot produce negative (or inflated) latencies."""
+    from fastconsensus_tpu.serve import jobs as jobs_mod
+
+    wall = [1_000_000.0]
+    monkeypatch.setattr(jobs_mod.time, "time", lambda: wall[0])
+    job = _job()
+    job.mark("running")
+    wall[0] -= 3600.0                       # NTP steps back an hour
+    job.mark("done", result={})
+    d = job.describe()
+    assert d["finished_at"] < d["submitted_at"]   # wall shows the step
+    t = job.timing()
+    assert t is not None
+    assert 0.0 <= t["e2e_ms"] < 1000.0            # monotonic does not
+    assert t["phases_ms"]["respond"] >= 0.0
+
+
+def test_phase_sum_equals_e2e_with_missing_stamps():
+    """Phases are consecutive differences of one monotonic clock, so
+    their sum equals the end-to-end latency BY CONSTRUCTION, whatever
+    subset of stamps a path recorded (cache hits never pack, solo jobs
+    never batch...)."""
+    job = _job()
+    job.stamp("dispatched")
+    job.stamp("dequeued")       # no "enqueued": folds into deque_wait
+    job.stamp("device_done")    # no "packed": folds into device
+    job.mark("done", result={})
+    t = job.timing()
+    assert set(t["phases_ms"]) == {"queue_wait", "deque_wait",
+                                   "device", "respond"}
+    assert t["phase_sum_ms"] == pytest.approx(t["e2e_ms"], abs=0.01)
+
+
+def test_slo_classes_and_targets():
+    from fastconsensus_tpu.serve.jobs import (PRIORITY_INTERACTIVE,
+                                              SLO_CLASSES)
+
+    j = _job()
+    assert j.spec.slo_class() == "normal"
+    assert j.spec.slo_target() == SLO_CLASSES["normal"]
+    j = _job(priority=PRIORITY_INTERACTIVE)
+    assert j.spec.slo_class() == "interactive"
+    j = _job(slo="batch", slo_target_ms=5.0)
+    assert j.spec.slo_class() == "batch"
+    assert j.spec.slo_target() == 5.0
+    j.mark("done", result={})
+    assert j.timing()["slo"] == "batch"
+
+
+# -- the loopback consistency pin (satellite) --------------------------
+
+
+def test_loopback_phase_sum_and_metricsz_schema(karate_edges):
+    """The fclat acceptance pin on a REAL loopback run: every finished
+    job's phase sum agrees with its end-to-end latency within 5%, the
+    /metricsz latency block carries per-phase histograms + arrival
+    rates + SLO attainment in the documented schema (typed by the
+    jax-free client), and a deliberately impossible SLO target counts
+    one miss."""
+    from fastconsensus_tpu.serve.client import ServeClient
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig,
+                                                make_http_server)
+
+    edges, _, ids = karate_edges
+    svc = ConsensusService(ServeConfig(queue_depth=8, pin_sizing=False))
+    svc.start()
+    httpd = make_http_server(svc, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout=30.0)
+    try:
+        payload = dict(edges=edges.tolist(), n_nodes=len(ids),
+                       algorithm="lpm", n_p=4, delta=0.1, max_rounds=2)
+        subs = [client.submit(**dict(payload, seed=71)),
+                client.submit(**dict(payload, seed=72,
+                                     slo="interactive",
+                                     slo_target_ms=0.001))]
+        results = [client.wait(s["job_id"], timeout=120) for s in subs]
+        for res in results:
+            t = res["timing"]
+            assert abs(t["phase_sum_ms"] - t["e2e_ms"]) <= \
+                0.05 * t["e2e_ms"] + 0.01, t
+            assert t["phases_ms"]["device"] > 0.0
+        # an impossible target is a counted miss, not an enforcement
+        t2 = client.timing(subs[1]["job_id"])
+        assert t2 is not None and t2.slo == "interactive"
+        assert t2.slo_met is False
+        lat = client.latency()
+        names = {h.name for h in lat["histograms"]}
+        assert "serve.e2e" in names
+        assert "serve.phase.device" in names
+        e2e = next(h for h in lat["histograms"]
+                   if h.name == "serve.e2e"
+                   and h.tags.get("bucket") == "n64_e96")
+        assert e2e.count >= 1 and e2e.p95_s > 0
+        assert e2e.tags["rung"] == "1"
+        assert lat["arrivals"]["n64_e96"]["count"] >= 2
+        slo = {s.slo_class: s for s in lat["slo"]}
+        assert slo["interactive"].missed >= 1
+        assert 0.0 <= slo["interactive"].attainment <= 1.0
+        # bad slo inputs answer 400, not a crash
+        from fastconsensus_tpu.serve.client import ServeError
+
+        with pytest.raises(ServeError) as e:
+            client.submit(**dict(payload, seed=73, slo="platinum"))
+        assert e.value.status == 400 and "slo" in str(e.value)
+        with pytest.raises(ServeError) as e:
+            client.submit(**dict(payload, seed=74, slo_target_ms=-1))
+        assert e.value.status == 400
+        # the raw block stays JSON end to end
+        json.dumps(client.metricsz())
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        assert svc.drain(30)
+
+
+def test_metricsz_typed_parse_is_jax_free():
+    """The client-contract satellite: parsing the /metricsz latency
+    block and a /result timing block into the typed client objects
+    must work with jax POISONED in sys.modules — thin dashboards
+    never pay the engine import."""
+    canned_latency = {
+        "histograms": [{"name": "serve.phase.device",
+                        "tags": {"bucket": "n64_e96", "rung": 2,
+                                 "priority": 1, "device": 0},
+                        "count": 3, "sum_s": 0.09, "min_s": 0.02,
+                        "max_s": 0.04, "p50_s": 0.03125,
+                        "p95_s": 0.04, "p99_s": 0.04,
+                        "buckets": {"-5": 3}}],
+        "slo": {"interactive": {"met": 5, "missed": 1,
+                                "attainment": 0.8333,
+                                "target_default_ms": 1000.0}},
+        "arrivals": {"n64_e96": {"count": 6, "window": 6,
+                                 "window_s": 2.0, "rate_per_s": 2.5}},
+        "dispatches": {},
+    }
+    canned_timing = {"e2e_ms": 12.5,
+                     "phases_ms": {"queue_wait": 1.0, "device": 11.0,
+                                   "respond": 0.5},
+                     "phase_sum_ms": 12.5, "slo": "interactive",
+                     "slo_target_ms": 1000.0, "slo_met": True}
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "import json\n"
+        "from fastconsensus_tpu.serve.client import (JobTiming,\n"
+        "    PhaseLatency, SloStats)\n"
+        f"block = json.loads({json.dumps(json.dumps(canned_latency))})\n"
+        f"t = json.loads({json.dumps(json.dumps(canned_timing))})\n"
+        "hs = [PhaseLatency.from_payload(h)\n"
+        "      for h in block['histograms']]\n"
+        "assert hs[0].tags == {'bucket': 'n64_e96', 'rung': '2',\n"
+        "                      'priority': '1', 'device': '0'}, hs\n"
+        "assert hs[0].count == 3 and hs[0].p95_s == 0.04\n"
+        "assert hs[0].buckets == {'-5': 3}\n"
+        "s = SloStats.from_payload('interactive',\n"
+        "                          block['slo']['interactive'])\n"
+        "assert s.met == 5 and s.missed == 1\n"
+        "jt = JobTiming.from_payload(t)\n"
+        "assert jt.slo_met and jt.phases_ms['device'] == 11.0\n"
+        "assert abs(jt.phase_sum_ms - jt.e2e_ms) < 1e-9\n"
+        "print('jax-free latency parse ok')\n")
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, PYTHONPATH=os.path.abspath(root))
+    res = subprocess.run([sys.executable, "-c", code], cwd=root, env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "jax-free latency parse ok" in res.stdout
+
+
+def test_failed_job_counts_as_slo_miss():
+    """An outage must crater attainment, not hide behind the surviving
+    successes: a FAILED job counts serve.slo.<class>.missed and
+    records into serve.e2e.failed — never into the served
+    distributions."""
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.obs.latency import get_latency_registry
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    reg = obs_counters.get_registry()
+    base = reg.counters()
+    svc = ConsensusService(ServeConfig(queue_depth=4,
+                                       pin_sizing=False)).start()
+    try:
+        # closure_tau out of range fails inside run_consensus — the
+        # canonical job-level failure (test_serve.py uses the same)
+        from fastconsensus_tpu.consensus import ConsensusConfig
+        from fastconsensus_tpu.serve.jobs import JobSpec
+
+        spec = JobSpec(edges=np.array([[0, 1]], dtype=np.int64),
+                       n_nodes=2,
+                       config=ConsensusConfig(closure_tau=5.0, seed=91))
+        job = svc.submit(spec)
+        deadline = time.monotonic() + 120
+        while job.state not in ("done", "failed"):
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        assert job.state == "failed"
+    finally:
+        assert svc.drain(30)
+    since = reg.counters_since(base)
+    assert since.get("serve.slo.missed", 0) >= 1
+    assert since.get("serve.slo.normal.missed", 0) >= 1
+    failed_hists = [h for h in
+                    get_latency_registry().snapshot()["histograms"]
+                    if h["name"] == "serve.e2e.failed"]
+    assert failed_hists and sum(h["count"] for h in failed_hists) >= 1
+
+
+# -- timeline recording through the embedded service -------------------
+
+
+def test_queue_and_pool_stamps_reach_the_histograms(karate_edges):
+    """A job driven through the real queue -> dispatcher -> worker path
+    records every phase (queue_wait through respond) into the tagged
+    fclat histograms, and arrivals/dispatch rates both mark."""
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.obs.latency import get_latency_registry
+    from fastconsensus_tpu.serve.jobs import JobSpec
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    edges, _, ids = karate_edges
+    lat = get_latency_registry()
+    before = {(h["name"], tuple(sorted(h["tags"].items()))): h
+              for h in lat.snapshot()["histograms"]}
+    svc = ConsensusService(ServeConfig(queue_depth=4,
+                                       pin_sizing=False)).start()
+    try:
+        spec = JobSpec(edges=np.asarray(edges, dtype=np.int64),
+                       n_nodes=len(ids),
+                       config=ConsensusConfig(algorithm="lpm", n_p=4,
+                                              tau=0.8, delta=0.1,
+                                              max_rounds=2, seed=81))
+        job = svc.submit(spec)
+        deadline = time.monotonic() + 120
+        while job.state not in ("done", "failed"):
+            assert time.monotonic() < deadline, job.describe()
+            time.sleep(0.02)
+        assert job.state == "done", job.error
+    finally:
+        assert svc.drain(30)
+    from fastconsensus_tpu.obs.latency import diff_snapshots
+
+    grew = set()
+    for h in lat.snapshot()["histograms"]:
+        key = (h["name"], tuple(sorted(h["tags"].items())))
+        if diff_snapshots(h, before.get(key, {}))["count"]:
+            grew.add(h["name"])
+    for phase in ("queue_wait", "dispatch", "deque_wait", "pack",
+                  "device", "fanout", "respond"):
+        assert f"serve.phase.{phase}" in grew, (phase, sorted(grew))
+    assert "serve.e2e" in grew
+    assert lat.dispatches.rates().get("n64_e96", {}).get("count", 0) >= 1
