@@ -570,6 +570,14 @@ def bench_serve_load() -> int:
     Env knobs: FCTPU_SERVE_LOAD_RPS (default "2,4,8,16,32"),
     FCTPU_SERVE_LOAD_SECONDS per point (default 8),
     FCTPU_SERVE_LOAD_DEPTH (queue depth, default 32),
+    FCTPU_SERVE_LOAD_MIX ("interactive:0.5,normal:0.3,batch:0.2") —
+    ALSO sweep the same RPS grid with arrivals drawn from that SLO-
+    class mix, recorded under ``telemetry.serve_load.mixed``: the
+    workload that actually exercises EDF ordering and deadline
+    shedding (per-class attainment reported per point).  The main
+    (gated) sweep stays single-class so ``history.check_serve_load``
+    keeps comparing like against like — a mix change can never read as
+    a tail-latency regression,
     FCTPU_SERVE_LOAD_OUT (also write the JSON artifact to a file —
     runs/bench_serve_load_rNN.json is the committed, gated shape).
     """
@@ -601,12 +609,19 @@ def bench_serve_load() -> int:
     bucket = bucketer.bucket_for(64, 96)
     edges = bucketer.probe_edges(bucket).tolist()
 
+    # posture knob for A/B runs (the CI shaping smoke compares the
+    # hold-on curve against this no-hold control): 0 disables the
+    # hold-for-coalesce window, everything else keeps the defaults
+    hold_on = os.environ.get("FCTPU_SERVE_LOAD_HOLD", "1") != "0"
+    from fastconsensus_tpu.serve.shaping import ShapingConfig
+
     reg = obs_counters.get_registry()
     lat = obs_latency.get_latency_registry()
     svc = ConsensusService(ServeConfig(
         queue_depth=queue_depth, pin_sizing=False, devices=1,
         max_batch=max_batch, prewarm=(f"{bucket.key()}:{max_batch}",),
-        prewarm_config={"n_p": n_p, "max_rounds": max_rounds})).start()
+        prewarm_config={"n_p": n_p, "max_rounds": max_rounds},
+        shaping=ShapingConfig(hold=hold_on))).start()
     httpd = make_http_server(svc, "127.0.0.1", 0)
     port = httpd.server_address[1]
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
@@ -619,12 +634,29 @@ def bench_serve_load() -> int:
             raise TimeoutError("serve_load pre-warm never finished")
         time.sleep(0.2)
 
+    mix_env = os.environ.get("FCTPU_SERVE_LOAD_MIX", "")
+    mix: list = []
+    if mix_env:
+        from fastconsensus_tpu.serve.jobs import SLO_CLASSES
+
+        for part in mix_env.split(","):
+            cls, _, w = part.strip().partition(":")
+            if cls not in SLO_CLASSES:
+                raise ValueError(
+                    f"FCTPU_SERVE_LOAD_MIX: unknown SLO class {cls!r} "
+                    f"(one of {', '.join(SLO_CLASSES)})")
+            mix.append((cls, float(w) if w else 1.0))
+        total_w = sum(w for _, w in mix)
+        if total_w <= 0:
+            raise ValueError("FCTPU_SERVE_LOAD_MIX: weights must sum > 0")
+        mix = [(cls, w / total_w) for cls, w in mix]
+
     seed_counter = iter(range(10_000_000))
-    points = []
     worst_consistency = 0.0
     total_warm = 0
-    try:
-        for rps in rps_grid:
+
+    def run_point(rps, classes):
+            nonlocal worst_consistency, total_warm
             base = reg.counters()
             lat_before = lat.snapshot()
             rng = np.random.default_rng(int(rps * 1000) + 9)
@@ -686,8 +718,10 @@ def bench_serve_load() -> int:
 
             poller = threading.Thread(target=poll_loop, daemon=True)
             poller.start()
-            submitted = rejected = 0
+            submitted = rejected = shed_rejects = 0
             submit_lag_ms: list = []
+            class_names = [c for c, _ in classes] if classes else None
+            class_weights = [w for _, w in classes] if classes else None
             t0 = time.monotonic()
             # fcheck: ok=sync-in-loop (the open-loop arrival clock:
             # sleep-until-schedule then one loopback HTTP submit per
@@ -700,14 +734,20 @@ def bench_serve_load() -> int:
                 submit_lag_ms.append(
                     (time.monotonic() - target) * 1000.0)
                 submitted += 1
+                # mixed-SLO workloads submit priority == class, so the
+                # EDF heap actually has inter-class ordering to do and
+                # deadline sheds see genuinely tight deadlines
+                cls = "interactive" if class_names is None else \
+                    str(rng.choice(class_names, p=class_weights))
                 try:
                     sub = client.submit(
                         edges=edges, n_nodes=bucket.n_class,
                         algorithm="louvain", n_p=n_p,
                         max_rounds=max_rounds, seed=next(seed_counter),
-                        slo="interactive")
-                except Backpressure:
+                        slo=cls, priority=cls)
+                except Backpressure as e:
                     rejected += 1
+                    shed_rejects += 1 if e.shed else 0
                     continue
                 with done_lock:
                     outstanding[sub["job_id"]] = target
@@ -747,6 +787,17 @@ def bench_serve_load() -> int:
                     worst_consistency = max(worst_consistency, gap / e2e)
             met = since.get("serve.slo.met", 0)
             missed = since.get("serve.slo.missed", 0)
+            slo_by_class = {}
+            for cls_name in ("interactive", "normal", "batch"):
+                c_met = since.get(f"serve.slo.{cls_name}.met", 0)
+                c_missed = since.get(f"serve.slo.{cls_name}.missed", 0)
+                if c_met or c_missed:
+                    slo_by_class[cls_name] = {
+                        "met": c_met, "missed": c_missed,
+                        "attainment": round(
+                            c_met / (c_met + c_missed), 4)}
+            batched_calls = since.get("serve.batch.coalesced", 0)
+            batched_jobs = since.get("serve.batch.occupancy", 0)
             lat_by_phase: dict = {}
             before_by_key = {
                 (h["name"], tuple(sorted(h["tags"].items()))): h
@@ -791,10 +842,27 @@ def bench_serve_load() -> int:
                 "slo": {"met": met, "missed": missed,
                         "attainment": round(met / (met + missed), 4)
                         if met + missed else None},
+                "slo_by_class": slo_by_class,
+                "rejected_shed": shed_rejects,
+                # fcshape visibility: how much the hold-for-coalesce
+                # window actually batched this point's traffic (the
+                # acceptance signal — occupancy up, tail flat)
+                "batch": {
+                    "batched_calls": batched_calls,
+                    "batched_jobs": batched_jobs,
+                    "mean_occupancy": round(
+                        batched_jobs / batched_calls, 3)
+                    if batched_calls else 0.0,
+                    "batched_frac": round(batched_jobs / completed, 4)
+                    if completed else 0.0,
+                    "holds": since.get("serve.shape.holds", 0),
+                    "bypass": since.get("serve.shape.bypass", 0),
+                    "deadline_sheds": since.get(
+                        "serve.shape.deadline_sheds", 0),
+                },
                 "phase_p95_ms": phase_p95_ms,
                 "compiles": warm,
             }
-            points.append(point)
             if warm:
                 print(f"WARNING: the timed rps={rps} window compiled "
                       f"{warm} executable(s) — the pre-warm is not "
@@ -803,6 +871,16 @@ def bench_serve_load() -> int:
             if stranded or failed[0]:
                 print(f"WARNING: rps={rps}: {stranded} job(s) never "
                       f"finished, {failed[0]} failed", file=sys.stderr)
+            return point
+
+    points: list = []
+    mixed_points: list = []
+    try:
+        for rps in rps_grid:
+            points.append(run_point(rps, None))
+        if mix:
+            for rps in rps_grid:
+                mixed_points.append(run_point(rps, mix))
     finally:
         httpd.shutdown()
         httpd.server_close()
@@ -836,12 +914,30 @@ def bench_serve_load() -> int:
             "serve_load": {
                 "reference_rps": reference_rps,
                 "slo_class": "interactive",
+                # the MAIN sweep's workload mix — always None today
+                # (single-class by design, so the r09 gate anchor keeps
+                # comparing like against like); stamped explicitly
+                # because history.check_serve_load anchors on
+                # (reference_rps, mix): if a future sweep ever mixes
+                # the gated points, its records must not compare
+                # against single-class priors
+                "mix": None,
                 "queue_depth": queue_depth,
                 "max_batch": max_batch,
                 "points": points,
             },
         },
     }
+    if mixed_points:
+        # the mixed-SLO sweep rides the SAME artifact but its own
+        # block: history.check_serve_load anchors on the main points,
+        # so changing (or dropping) the mix can never masquerade as a
+        # tail-latency regression — while the per-class attainment the
+        # EDF/shedding arms are judged by stays committed evidence
+        out["telemetry"]["serve_load"]["mixed"] = {
+            "mix": mix_env,
+            "points": mixed_points,
+        }
     print(json.dumps(out))
     if out_path:
         with open(out_path, "w") as fh:
@@ -851,7 +947,8 @@ def bench_serve_load() -> int:
               file=sys.stderr)
     ok = (total_warm == 0 and consistency_ok
           and all(p["completed"] > 0 and p["stranded"] == 0
-                  and p["failed"] == 0 for p in points))
+                  and p["failed"] == 0
+                  for p in points + mixed_points))
     return 0 if ok else 1
 
 

@@ -227,7 +227,15 @@ def entry_points() -> List[EntryPoint]:
     # shapes).  It registers no entry points; the AST lint walks the
     # package tree (including serve/), and the server's deliberate host
     # syncs carry `# fcheck: ok=sync-in-loop` pragmas with reasons
-    # (serve/server.py run_spec's partition readback loop).
+    # (serve/server.py run_spec's partition readback loop).  The
+    # fcshape addition serve/shaping.py is host-only by the same
+    # reasoning taken further: pure stdlib admission-control arithmetic
+    # (EDF deadlines, hold-window/fill prediction, Retry-After and shed
+    # math over the fclat histograms) that deliberately never imports
+    # jax — its batch-ladder mirror is pinned against bucketer by test
+    # so the jax-free guarantee survives ladder changes — and whose
+    # only mutable state (the estimate cache) is guarded by one leaf
+    # lock the concurrency pass verifies without pragmas.
     assert available()  # registry import sanity
     return eps
 

@@ -263,8 +263,26 @@ def device_table(groups: Dict[str, List[dict]],
     return "\n".join(lines).rstrip()
 
 
-_SL_PHASES = ("queue_wait", "dispatch", "deque_wait", "pack", "device",
-              "fanout", "respond")
+_SL_PHASES = ("queue_wait", "hold", "dispatch", "deque_wait", "pack",
+              "device", "fanout", "respond")
+_SL_CLASSES = ("interactive", "normal", "batch")
+
+
+def _sl_rows(points) -> List[List[str]]:
+    rows = []
+    for pt in points:
+        slo = pt.get("slo") or {}
+        phases = pt.get("phase_p95_ms") or {}
+        batch = pt.get("batch") or {}
+        rows.append(
+            [_fmt(pt.get("rps")), _fmt(pt.get("achieved_rps")),
+             _fmt(pt.get("completed"), 0),
+             _fmt(pt.get("rejected_429"), 0),
+             _fmt(pt.get("p50_ms")), _fmt(pt.get("p95_ms")),
+             _fmt(pt.get("p99_ms")), _fmt(slo.get("attainment")),
+             _fmt(batch.get("mean_occupancy"))]
+            + [_fmt(phases.get(p)) for p in _SL_PHASES])
+    return rows
 
 
 def serve_load_table(groups: Dict[str, List[dict]],
@@ -273,11 +291,14 @@ def serve_load_table(groups: Dict[str, List[dict]],
     ``serve_load`` curve (the ``bench.py serve_load`` artifacts): per
     swept RPS point, the achieved throughput, client-observed and
     server-side percentiles, the 429/backpressure rate, SLO attainment,
-    and the per-phase p95 breakdown — so a coalescing or admission
-    change shows up as queue-wait movement, not just a throughput
-    scalar.  Empty string when no record has a curve."""
+    mean batch-rung occupancy, and the per-phase p95 breakdown — so a
+    coalescing or admission change shows up as queue-wait/hold
+    movement, not just a throughput scalar.  A record carrying the
+    fcshape ``mixed`` block (the mixed-SLO-class sweep) renders a
+    second table with per-class attainment columns.  Empty string when
+    no record has a curve."""
     header = (["rps", "achieved", "jobs", "429s", "p50_ms", "p95_ms",
-               "p99_ms", "slo_attain"]
+               "p99_ms", "slo_attain", "occup"]
               + [f"{p}_p95" for p in _SL_PHASES])
     lines: List[str] = []
     for config, recs in groups.items():
@@ -285,21 +306,30 @@ def serve_load_table(groups: Dict[str, List[dict]],
                        if r.get("serve_load")), None)
         if newest is None:
             continue
-        rows = []
-        for pt in newest["serve_load"].get("points", ()):
-            slo = pt.get("slo") or {}
-            phases = pt.get("phase_p95_ms") or {}
-            rows.append(
-                [_fmt(pt.get("rps")), _fmt(pt.get("achieved_rps")),
-                 _fmt(pt.get("completed"), 0),
-                 _fmt(pt.get("rejected_429"), 0),
-                 _fmt(pt.get("p50_ms")), _fmt(pt.get("p95_ms")),
-                 _fmt(pt.get("p99_ms")), _fmt(slo.get("attainment"))]
-                + [_fmt(phases.get(p)) for p in _SL_PHASES])
         ref = newest["serve_load"].get("reference_rps")
         lines += _render_rows(
             f"{config} latency vs RPS [{newest['source']}; "
-            f"reference rps {_fmt(ref)}]", header, rows, markdown)
+            f"reference rps {_fmt(ref)}]", header,
+            _sl_rows(newest["serve_load"].get("points", ())), markdown)
+        mixed = newest["serve_load"].get("mixed")
+        if mixed:
+            mix_header = (["rps", "p95_ms", "429s", "sheds", "occup"]
+                          + [f"{c}_attain" for c in _SL_CLASSES])
+            rows = []
+            for pt in mixed.get("points", ()):
+                by_cls = pt.get("slo_by_class") or {}
+                batch = pt.get("batch") or {}
+                rows.append(
+                    [_fmt(pt.get("rps")), _fmt(pt.get("p95_ms")),
+                     _fmt(pt.get("rejected_429"), 0),
+                     _fmt(pt.get("rejected_shed"), 0),
+                     _fmt(batch.get("mean_occupancy"))]
+                    + [_fmt((by_cls.get(c) or {}).get("attainment"))
+                       for c in _SL_CLASSES])
+            lines.append("")
+            lines += _render_rows(
+                f"{config} mixed-SLO sweep [{newest['source']}; "
+                f"mix {mixed.get('mix')}]", mix_header, rows, markdown)
     return "\n".join(lines).rstrip()
 
 
@@ -344,14 +374,24 @@ def check_serve_load(groups: Dict[str, List[dict]],
             continue
         latest_seq = max(r["seq"] for r in seqd)
         latest = [r for r in seqd if r["seq"] == latest_seq]
-        latest_refs = {(r.get("serve_load") or {}).get("reference_rps")
+        latest_refs = {((r.get("serve_load") or {}).get("reference_rps"),
+                        (r.get("serve_load") or {}).get("mix"))
                        for r in latest}
-        # compare at the SAME reference RPS only: a sweep whose grid
-        # (and therefore reference point) changed has no prior anchor —
-        # judging its 8-rps p95 against a 2-rps median would
-        # manufacture a "regression" out of ordinary queueing
+        # compare at the SAME (reference RPS, workload mix) only: a
+        # sweep whose grid (and therefore reference point) changed has
+        # no prior anchor — judging its 8-rps p95 against a 2-rps
+        # median would manufacture a "regression" out of ordinary
+        # queueing — and neither has one whose SLO-class mix changed
+        # (fcshape: a mixed workload queues differently by design; the
+        # mixed sweep itself rides the separate `mixed` block, which
+        # never gates).  bench.py stamps the main sweep's mix
+        # explicitly (None = single-class, the only value it emits
+        # today); pre-fcshape artifacts carry no key and read as None
+        # too, so existing histories keep gating, while any future
+        # mixed-main record separates from single-class priors here.
         prior = [r for r in seqd if r["seq"] < latest_seq
-                 and (r.get("serve_load") or {}).get("reference_rps")
+                 and ((r.get("serve_load") or {}).get("reference_rps"),
+                      (r.get("serve_load") or {}).get("mix"))
                  in latest_refs]
         prior_pts = [(_sl_ref_point(r), r) for r in prior]
         prior_p95 = [p["p95_ms"] for p, _ in prior_pts

@@ -209,12 +209,20 @@ def diff_snapshots(new: Dict[str, Any],
 
 
 class RateTracker:
-    """Per-key arrival-rate tracking over a bounded stamp window."""
+    """Per-key arrival-rate tracking over a bounded stamp window.
+
+    ``max_keys`` bounds the KEY cardinality (the per-key windows are
+    already bounded): trackers keyed by open-ended identifiers — the
+    shaping group tracker, whose keys are batch-group strings minted
+    per distinct config — evict the longest-idle key past the cap, so
+    a resident server's memory cannot grow with config diversity.
+    """
 
     WINDOW = 256
 
-    def __init__(self) -> None:
+    def __init__(self, max_keys: Optional[int] = None) -> None:
         self._lock = threading.Lock()
+        self._max_keys = max_keys
         self._marks: Dict[str, deque] = {}
         self._totals: Dict[str, int] = {}
 
@@ -223,6 +231,12 @@ class RateTracker:
         with self._lock:
             marks = self._marks.get(name)
             if marks is None:
+                if self._max_keys is not None and \
+                        len(self._marks) >= self._max_keys:
+                    idle = min(self._marks,
+                               key=lambda k: self._marks[k][-1])
+                    del self._marks[idle]
+                    self._totals.pop(idle, None)
                 marks = self._marks[name] = deque(maxlen=self.WINDOW)
             marks.append(t)
             self._totals[name] = self._totals.get(name, 0) + 1
@@ -251,10 +265,48 @@ class RateTracker:
             }
         return out
 
+    # The control-signal recency horizon (seconds): rate() judges only
+    # marks this recent.  The full-window view (rates()) spans to the
+    # oldest retained mark, which is right for exposition but wrong
+    # for burst detection — after a quiet spell, a handful of old
+    # sparse marks would dilute a fresh burst's rate for the whole
+    # window and the hold-for-coalesce consumer would never see it.
+    HORIZON_S = 0.5
+
+    def rate(self, name: str, now: Optional[float] = None,
+             horizon_s: Optional[float] = None) -> float:
+        """One key's CURRENT arrivals/s — the traffic shaper's control
+        signal (serve/shaping.py): computed over the marks inside the
+        trailing ``horizon_s`` window only (default
+        :data:`HORIZON_S`), spanning to NOW, so a fresh burst registers
+        within a few arrivals and an idle key reads 0.0 as soon as the
+        horizon empties.  Single-key on purpose: the hold decision runs
+        under the admission queue's condition, where recomputing every
+        key's window would scale the lock hold time with bucket
+        cardinality."""
+        t_now = time.monotonic() if now is None else float(now)
+        h = self.HORIZON_S if horizon_s is None else float(horizon_s)
+        cutoff = t_now - h
+        with self._lock:
+            marks = self._marks.get(name)
+            if marks is None or len(marks) < 2:
+                return 0.0
+            recent = [m for m in marks if m >= cutoff]
+        if len(recent) < 2:
+            return 0.0
+        span = max(t_now - recent[0], 0.0)
+        return (len(recent) - 1) / span if span > 0 else 0.0
+
     def reset(self) -> None:
         with self._lock:
             self._marks.clear()
             self._totals.clear()
+
+
+# The phases that constitute one job's *service* time — work the device
+# path actually performs per job, as opposed to time spent queued/held/
+# routed.  The shaping estimator sums these per-phase distributions.
+SERVICE_PHASES: Tuple[str, ...] = ("pack", "device", "fanout")
 
 
 def _tag_key(tags: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
@@ -275,6 +327,14 @@ class LatencyRegistry:
                           LatencyHistogram] = {}
         self.arrivals = RateTracker()
         self.dispatches = RateTracker()
+        # Per-BATCH-GROUP arrival tracking (fcshape): a rung can only
+        # fill with same-group arrivals (bucket + config-minus-seed),
+        # so the hold predictor prefers this over the per-bucket rate —
+        # mixed-config traffic on one bucket would otherwise trigger
+        # holds that can never fill.  Key-capped (group strings are
+        # open-ended) and deliberately NOT in snapshot(): it is a
+        # control signal, not an exposition surface.
+        self.group_arrivals = RateTracker(max_keys=1024)
 
     def hist(self, name: str, **tags: Any) -> LatencyHistogram:
         key = (str(name), _tag_key(tags))
@@ -297,11 +357,60 @@ class LatencyRegistry:
             "dispatches": self.dispatches.rates(),
         }
 
+    def service_estimate(self, bucket: Optional[str] = None,
+                         min_count: int = 1) -> Optional[Dict[str, Any]]:
+        """Measured per-job service time (seconds) derived from the
+        existing ``serve.phase.*`` histograms — the traffic shaper's
+        (serve/shaping.py) view of how long one job occupies the
+        serving path once dispatched.
+
+        Per phase in :data:`SERVICE_PHASES` the tagged histograms are
+        exact-merged (``bucket`` filters to one shape bucket; rung-0 —
+        cache-hit — histograms are always excluded: a hit performs no
+        service, and ``cold``-tagged ones too: a compiling job's device
+        phase measures XLA, not serving), then combined across phases: the mean is the sum of
+        per-phase means (phases tile a job's lifetime, so means add
+        exactly) and ``p95_s`` the sum of per-phase p95s (a
+        conservative upper bound — quantiles do not add, but for a
+        deadline-slack bound only overestimation is safe).  Batched
+        jobs stamp the whole batched call's duration as each member's
+        device phase, which also overestimates per-job service — the
+        same safe direction.  None until the device phase has
+        ``min_count`` samples.
+        """
+        with self._lock:
+            items = list(self._hists.items())
+        per_phase: Dict[str, List[Dict[str, Any]]] = {}
+        prefix = "serve.phase."
+        for (name, tags), h in items:
+            if not name.startswith(prefix):
+                continue
+            phase = name[len(prefix):]
+            if phase not in SERVICE_PHASES:
+                continue
+            td = dict(tags)
+            if td.get("rung") == "0" or td.get("cold"):
+                continue
+            if bucket is not None and td.get("bucket") != str(bucket):
+                continue
+            per_phase.setdefault(phase, []).append(h.snapshot())
+        merged = {p: merge_snapshots(s) for p, s in per_phase.items()}
+        dev = merged.get("device")
+        if dev is None or dev["count"] < max(int(min_count), 1):
+            return None
+        mean = sum(m["sum_s"] / m["count"]
+                   for m in merged.values() if m["count"])
+        p95 = sum(m["p95_s"] or 0.0 for m in merged.values())
+        return {"count": dev["count"],
+                "mean_s": round(mean, 9),
+                "p95_s": round(p95, 9)}
+
     def reset(self) -> None:
         with self._lock:
             self._hists.clear()
         self.arrivals.reset()
         self.dispatches.reset()
+        self.group_arrivals.reset()
 
 
 _REGISTRY = LatencyRegistry()

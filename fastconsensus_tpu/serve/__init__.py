@@ -41,7 +41,8 @@ _EXPORTS = {
     "Job": "jobs", "JobSpec": "jobs", "content_hash": "jobs",
     "SLO_CLASSES": "jobs",
     "AdmissionQueue": "queue", "QueueClosed": "queue",
-    "QueueFull": "queue",
+    "QueueFull": "queue", "DeadlineShed": "queue",
+    "ShapingConfig": "shaping", "TrafficShaper": "shaping",
     "ConsensusService": "server", "GraphTooLarge": "server",
     "ServeConfig": "server", "make_http_server": "server",
     "DeviceWorker": "pool", "MeshWorker": "pool", "WorkerPool": "pool",

@@ -102,6 +102,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "work leaves its home device only when more "
                         "than J jobs are queued there (default "
                         f"{d.spill_backlog})")
+    p.add_argument("--hold-ms", type=float, default=None, metavar="MS",
+                   help="cap on the adaptive hold-for-coalesce window "
+                        "(serve/shaping.py): pop_batch may wait up to "
+                        "MS ms — never past the tightest queued "
+                        "deadline's slack — for predicted same-bucket "
+                        "arrivals to fill a larger batch rung "
+                        "(default 50; 0 disables holding)")
+    p.add_argument("--no-edf", action="store_true",
+                   help="order the admission queue FIFO within a "
+                        "priority instead of earliest-deadline-first")
+    p.add_argument("--no-hold", action="store_true",
+                   help="disable the hold-for-coalesce window "
+                        "(pop_batch never waits — the pre-fcshape "
+                        "posture)")
+    p.add_argument("--no-shed", action="store_true",
+                   help="disable deadline-aware shedding (jobs that "
+                        "provably cannot meet their SLO at the current "
+                        "depth are queued anyway; 429s still carry the "
+                        "derived Retry-After)")
     p.add_argument("--no-pin-sizing", action="store_true",
                    help="let the engine re-size executables adaptively "
                         "per request (default: pinned — stable bucket "
@@ -225,6 +244,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"OOM on first traffic (footprint model)",
                   file=sys.stderr)
             return 2
+    from fastconsensus_tpu.serve.shaping import ShapingConfig
+
+    shaping_defaults = ShapingConfig()
+    if args.hold_ms is not None and args.hold_ms < 0:
+        print("error: --hold-ms must be >= 0", file=sys.stderr)
+        return 2
+    shaping = ShapingConfig(
+        edf=not args.no_edf,
+        hold=not args.no_hold and args.hold_ms != 0,
+        shed=not args.no_shed,
+        max_hold_s=(args.hold_ms / 1000.0 if args.hold_ms
+                    else shaping_defaults.max_hold_s))
     cfg = ServeConfig(queue_depth=args.queue_depth,
                       cache_entries=args.cache_entries,
                       cache_ttl_s=args.cache_ttl,
@@ -240,7 +271,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                       devices=args.devices,
                       huge_devices=args.huge_devices,
                       chip_max_edges=chip_max_edges,
-                      spill_backlog=args.spill_backlog)
+                      spill_backlog=args.spill_backlog,
+                      shaping=shaping)
     try:
         service = ConsensusService(cfg).start()
     except ValueError as e:

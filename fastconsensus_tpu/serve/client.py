@@ -66,9 +66,11 @@ class JobTiming:
     """A job's server-side fclat timing block (``/result`` /
     ``/status`` ``timing``), typed: monotonic-derived milliseconds per
     phase, the end-to-end latency, and the observed SLO verdict.  The
-    phase names tile the lifetime (queue_wait, dispatch, deque_wait,
-    pack, device, fanout, respond), so ``phase_sum_ms ~= e2e_ms`` —
-    the attribution-consistency contract tests pin server-side."""
+    phase names tile the lifetime (queue_wait, hold, dispatch,
+    deque_wait, pack, device, fanout, respond — ``hold`` is the
+    fcshape hold-for-coalesce window, 0 for un-held jobs), so
+    ``phase_sum_ms ~= e2e_ms`` — the attribution-consistency contract
+    tests pin server-side."""
 
     e2e_ms: float
     phases_ms: Dict[str, float]
@@ -137,6 +139,68 @@ class SloStats:
                    target_default_ms=float(s["target_default_ms"]))
 
 
+@dataclasses.dataclass(frozen=True)
+class ShapingStats:
+    """The ``/metricsz`` ``shaping`` block (serve/shaping.py), typed:
+    which control-loop arms are live, the ``serve.shape.*`` counters
+    (holds / bypasses / EDF promotions / deadline sheds), the
+    per-bucket measured service-time estimates the loop decides on,
+    and the Retry-After a 429 issued right now would carry."""
+
+    edf: bool
+    hold: bool
+    shed: bool
+    max_hold_s: float
+    holds: int
+    bypass: int
+    edf_promotions: int
+    deadline_sheds: int
+    estimates: Dict[str, Dict[str, float]]
+    retry_after_hint_s: Optional[float]
+
+    @classmethod
+    def from_payload(cls, p: Dict[str, Any]) -> "ShapingStats":
+        cfg = p.get("config") or {}
+        c = p.get("counters") or {}
+        return cls(edf=bool(cfg.get("edf", False)),
+                   hold=bool(cfg.get("hold", False)),
+                   shed=bool(cfg.get("shed", False)),
+                   max_hold_s=float(cfg.get("max_hold_s", 0.0)),
+                   holds=int(c.get("holds", 0)),
+                   bypass=int(c.get("bypass", 0)),
+                   edf_promotions=int(c.get("edf_promotions", 0)),
+                   deadline_sheds=int(c.get("deadline_sheds", 0)),
+                   estimates={str(k): dict(v) for k, v in
+                              (p.get("estimates") or {}).items()},
+                   retry_after_hint_s=p.get("retry_after_hint_s"))
+
+
+# What Backpressure.retry_after_s reports when the server sent no (or a
+# malformed) Retry-After — the pre-fcshape constant, kept as the
+# honest "we know nothing" floor.
+DEFAULT_RETRY_AFTER_S = 1.0
+
+
+def _retry_after_s(header: Optional[str],
+                   payload: Dict[str, Any]) -> float:
+    """The retry delay a 429 carried, in seconds: the JSON body's
+    unrounded ``retry_after_s`` float when present (the header is
+    integer delta-seconds, rounded UP server-side), else the parsed
+    header, else :data:`DEFAULT_RETRY_AFTER_S`.  Malformed or negative
+    values fall back to the default — a client must never interpret a
+    broken header as "hammer immediately" (or "wait forever")."""
+    for candidate in (payload.get("retry_after_s"), header):
+        if candidate is None:
+            continue
+        try:
+            v = float(candidate)
+        except (TypeError, ValueError):
+            continue
+        if v > 0.0:
+            return v
+    return DEFAULT_RETRY_AFTER_S
+
+
 class ServeError(RuntimeError):
     """Non-2xx response; carries the HTTP status and decoded payload."""
 
@@ -148,7 +212,17 @@ class ServeError(RuntimeError):
 
 
 class Backpressure(ServeError):
-    """HTTP 429: the admission queue is full — retry later."""
+    """HTTP 429: admission refused (queue full, or the job's deadline
+    is provably unmeetable at the current depth — ``shed``).  Retry
+    after ``retry_after_s`` seconds: the server derives it from queued
+    depth x its observed service rate, so honoring it converges on the
+    server's actual drain time instead of a fixed-backoff guess."""
+
+    def __init__(self, status: int, payload: Dict[str, Any],
+                 retry_after_s: float = DEFAULT_RETRY_AFTER_S) -> None:
+        super().__init__(status, payload)
+        self.retry_after_s = float(retry_after_s)
+        self.shed = bool(payload.get("shed", False))
 
 
 class JobFailed(ServeError):
@@ -183,7 +257,10 @@ class ServeClient:
             except ValueError:
                 body = {"error": str(e)}
             if e.code == 429:
-                raise Backpressure(e.code, body) from None
+                raise Backpressure(
+                    e.code, body,
+                    retry_after_s=_retry_after_s(
+                        e.headers.get("Retry-After"), body)) from None
             if e.code == 500 and path.startswith("/result/"):
                 raise JobFailed(e.code, body) from None
             raise ServeError(e.code, body) from None
@@ -250,6 +327,13 @@ class ServeClient:
             "arrivals": dict(block.get("arrivals") or {}),
             "dispatches": dict(block.get("dispatches") or {}),
         }
+
+    def shaping(self) -> ShapingStats:
+        """The traffic-shaping view from ``/metricsz``, typed: live
+        config arms, ``serve.shape.*`` counters, per-bucket service
+        estimates, and the current Retry-After hint."""
+        return ShapingStats.from_payload(
+            self.metricsz().get("shaping", {}))
 
     def timing(self, job_id: str) -> Optional[JobTiming]:
         """A finished job's typed server-side timing block (None while

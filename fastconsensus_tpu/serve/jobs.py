@@ -47,9 +47,12 @@ PRIORITY_NAMES = {
 # milliseconds.  A job's class defaults from its priority name and can
 # be overridden per request (``slo`` / ``slo_target_ms`` in the submit
 # body).  Attainment is *observed* — counted into ``serve.slo.*`` when
-# the job finishes — never enforced: the counters are the ground truth
-# a future EDF/deadline scheduler will be judged against, so they must
-# exist before it does.
+# the job finishes — and since fcshape (serve/shaping.py) the target
+# also SHAPES scheduling: it sets the job's absolute deadline
+# (``Job.deadline_mono``), which orders the admission heap (EDF within
+# a priority), bounds the hold-for-coalesce window, and drives
+# deadline-aware shedding at submit.  The counters remain the ground
+# truth the shaper is judged against.
 SLO_CLASSES = {
     "interactive": 1_000.0,
     "normal": 10_000.0,
@@ -63,9 +66,15 @@ SLO_CLASSES = {
 # (the /metricsz consistency pin in tests/test_latency.py).  A missing
 # stamp (e.g. a cache hit never packs) folds its interval into the next
 # present phase.  The trailing "respond" phase closes at the finished
-# stamp and is computed in Job.timing().
+# stamp and is computed in Job.timing().  Every pop path stamps
+# "hold_start" (Job.stamp_hold) alongside "dispatched", so for a job
+# the shaper never held the hold phase reads exactly 0 and queue_wait
+# keeps its pre-shaping meaning; only a job that never pops at all (a
+# submit-time cache hit) lacks both, folding its whole life into
+# "respond" as before.
 PHASE_STAMPS: Tuple[Tuple[str, str], ...] = (
-    ("queue_wait", "dispatched"),    # admission heap -> dispatcher pop
+    ("queue_wait", "hold_start"),    # admission heap -> hold/pop point
+    ("hold", "dispatched"),          # hold-for-coalesce window -> pop
     ("dispatch", "enqueued"),        # routing -> a worker's deque
     ("deque_wait", "dequeued"),      # parked in the deque -> worker
     ("pack", "packed"),              # canonicalize + pad to the bucket
@@ -262,6 +271,12 @@ class Job:
         # fclat phase timeline: monotonic checkpoints, written through
         # stamp() as the job crosses each serving stage (PHASE_STAMPS).
         self._mono: Dict[str, float] = {"admit": time.monotonic()}
+        # fcshape EDF deadline: the absolute monotonic instant this
+        # job's SLO expires.  The admission heap orders on it within a
+        # priority (serve/queue.py) and the hold-for-coalesce window is
+        # bounded by the tightest one queued (serve/shaping.py).
+        self.deadline_mono: float = \
+            self._mono["admit"] + spec.slo_target() / 1000.0
         self.error: Optional[str] = None
         self.result: Optional[Dict[str, Any]] = None
         # Cross-request batching metadata (serve/server.py): set when
@@ -298,13 +313,30 @@ class Job:
         with self._lock:
             return self._excluded
 
-    def stamp(self, name: str) -> None:
+    def stamp(self, name: str, at: Optional[float] = None) -> None:
         """Record one monotonic phase checkpoint (PHASE_STAMPS names).
         Re-stamping (a requeued job re-crosses the pipeline) keeps the
         LATEST time — the timeline then attributes the whole retry to
-        the phases it actually re-ran."""
+        the phases it actually re-ran.  ``at`` lets the queue stamp a
+        whole coalesced pop with ONE instant (and a non-holding pop
+        stamp ``hold_start``/``dispatched`` identically, so the hold
+        phase reads exactly 0, not clock-read jitter)."""
         with self._lock:
-            self._mono[name] = time.monotonic()
+            self._mono[name] = time.monotonic() if at is None \
+                else float(at)
+
+    def stamp_hold(self, t_begin: float) -> None:
+        """Record where this job's hold-for-coalesce window began
+        (closes the ``queue_wait`` phase; ``dispatched`` then closes
+        ``hold``).  A hold episode starts once per pop but covers every
+        group member, so ``t_begin`` is clamped into
+        ``[admit, now]`` — a ride-along admitted mid-hold attributes
+        only ITS share of the window, and a non-holding pop passes the
+        pop instant so hold reads exactly 0."""
+        with self._lock:
+            now = time.monotonic()
+            self._mono["hold_start"] = \
+                min(max(float(t_begin), self._mono["admit"]), now)
 
     def mark(self, state: str, result: Optional[Dict[str, Any]] = None,
              error: Optional[str] = None) -> None:

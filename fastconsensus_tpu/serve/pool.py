@@ -82,6 +82,7 @@ class _Worker:
         self.prewarm_specs: List[str] = []
         self.prewarm_left = 0
         self.tid: Optional[int] = None      # thread ident once running
+        self._running = False               # mid-batch right now
         self._batches: "deque[List[Job]]" = deque()
         self._cond = threading.Condition()
         self._closed = False
@@ -119,6 +120,14 @@ class _Worker:
         slot)."""
         with self._cond:
             return sum(len(b) for b in self._batches)
+
+    def is_busy(self) -> bool:
+        """Mid-batch or holding backlog — the fcshape hold-economics
+        probe (serve/shaping.py set_busy_probe): while every worker is
+        busy a held job would only have waited in a deque, so holding
+        for coalescing costs nothing."""
+        with self._cond:
+            return self._running or bool(self._batches)
 
     # -- dispatcher interface ---------------------------------------
 
@@ -187,6 +196,11 @@ class _Worker:
                 if self._batches:
                     batch = self._batches.popleft()
                     self._coalesce(batch)
+                    # busy flips on ATOMICALLY with the deque pop: a
+                    # gap between "deque emptied" and "running set"
+                    # would read as idle to the fcshape busy probe and
+                    # spuriously abort a free hold mid-handoff
+                    self._running = True
                     return batch
                 if self._closed:
                     return None
@@ -279,10 +293,13 @@ class _Worker:
             # from later deque entries stamp here too)
             job.stamp("dequeued")
         t0 = time.perf_counter()
+        with self._cond:
+            self._running = True
         try:
             self.service._drain_group(deque(batch), worker=self)
         finally:
             with self._cond:
+                self._running = False
                 self.busy_s += time.perf_counter() - t0
                 self.batches_done += 1
                 busy = self.busy_s
@@ -455,6 +472,18 @@ class WorkerPool:
         they hold no admission slot, exactly as before the pool)."""
         return sum(w.queued_jobs() for w in self.workers)
 
+    def chips_all_busy(self) -> bool:
+        """True when no eligible chip worker sits idle — the fcshape
+        busy probe: a hold-for-coalesce window is free exactly while
+        the head job could not have started anywhere anyway.  An EMPTY
+        eligible set (every chip cordoned) reports False: nothing can
+        serve a held job, so holding buys latency on a path already
+        headed for NoEligibleWorker.  Called under the admission
+        queue's condition; worker locks are always taken after it (the
+        documented extra_depth ordering)."""
+        eligible = [w for w in self.chip_workers if w.eligible()]
+        return bool(eligible) and all(w.is_busy() for w in eligible)
+
     def drain(self, timeout: Optional[float]) -> bool:
         """Join the dispatcher and every worker (the queue must already
         be closed — ConsensusService.begin_drain).  True = all exited."""
@@ -518,9 +547,11 @@ class WorkerPool:
             return f"solo:{job.job_id}", False
 
     def route_bucket(self, bucket_key: str, huge: bool,
-                     exclude: FrozenSet[int] = frozenset()) -> _Worker:
+                     exclude: FrozenSet[int] = frozenset(),
+                     n_jobs: int = 1) -> _Worker:
         tier = self.mesh_workers if huge else self.chip_workers
-        return self.scheduler.route(bucket_key, tier, exclude=exclude)
+        return self.scheduler.route(bucket_key, tier, exclude=exclude,
+                                    n_jobs=n_jobs)
 
     def dispatch(self, batch: List[Job]) -> None:
         """Route one coalesced pop.  Jobs requeued after a worker death
@@ -541,7 +572,8 @@ class WorkerPool:
         for (bucket_key, huge, exclude, _group), jobs in groups.items():
             try:
                 worker = self.route_bucket(bucket_key, huge,
-                                           exclude=exclude)
+                                           exclude=exclude,
+                                           n_jobs=len(jobs))
             except NoEligibleWorker as e:
                 for job in jobs:
                     job.mark(STATE_FAILED, error=str(e))
@@ -559,11 +591,14 @@ class WorkerPool:
         """Re-dispatch a dead worker's unfinished jobs directly (the
         admission queue may already be closed and drained mid-shutdown,
         so requeues never pass through it)."""
+        now = time.monotonic()
         for job in jobs:
             # requeues bypass the admission queue's pop, so the fclat
             # dispatch checkpoint is re-stamped here: the retry's
             # timeline re-opens at routing, not at a stale first pop
-            job.stamp("dispatched")
+            # (and not at a stale first hold — hold re-stamps to 0)
+            job.stamp_hold(now)
+            job.stamp("dispatched", at=now)
         self.dispatch(list(jobs))
 
     # -- the dispatcher ----------------------------------------------

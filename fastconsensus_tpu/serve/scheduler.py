@@ -68,13 +68,15 @@ class StickyScheduler:
             return dict(self._affinity)
 
     def route(self, bucket: str, workers: Sequence,
-              exclude: FrozenSet[int] = frozenset()):
+              exclude: FrozenSet[int] = frozenset(),
+              n_jobs: int = 1):
         """The worker that should run the next batch of ``bucket``.
 
         ``workers`` is the tier's worker list (chip workers for normal
         buckets, mesh workers for huge ones — serve/pool.py picks the
-        tier before calling).  Raises :class:`NoEligibleWorker` when
-        nothing can take the work.
+        tier before calling); ``n_jobs`` is how many jobs the routed
+        batch carries.  Raises :class:`NoEligibleWorker` when nothing
+        can take the work.
         """
         candidates = [w for w in workers if w.eligible(exclude)]
         if not candidates:
@@ -83,10 +85,15 @@ class StickyScheduler:
                 f"(excluded: {sorted(exclude)})")
         # fclat dispatch-rate tracking: together with the per-bucket
         # ARRIVAL rate marked at admission (serve/server.py submit),
-        # this is the signal pair the adaptive hold-for-coalesce window
-        # needs — arrivals/s tells expected time-to-fill a batch rung,
-        # dispatches/s tells how fast the pool is actually draining it.
-        self._lat.dispatches.mark(bucket)
+        # this is the signal pair the fcshape control loop reads —
+        # arrivals/s predicts the time-to-fill of a batch rung
+        # (hold-for-coalesce) and dispatches/s is the honest drain rate
+        # the deadline-shed math trusts.  Marked once PER JOB, not per
+        # batch: a rung-8 batch drains eight jobs, and a batch-counted
+        # rate would understate the drain by the mean occupancy —
+        # shedding work an 8-wide pool was about to serve.
+        for _ in range(max(int(n_jobs), 1)):
+            self._lat.dispatches.mark(bucket)
         with self._lock:
             home_idx = self._affinity.get(bucket)
             home = next((w for w in candidates if w.idx == home_idx),

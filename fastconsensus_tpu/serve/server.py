@@ -19,7 +19,14 @@ process whose jitted executables are reused across requests:
 * admission control is explicit: a bounded priority queue
   (serve/queue.py) rejects overload with backpressure (HTTP 429),
   oversized graphs are refused up front (413), and a draining server
-  says so (503) — accepted work always finishes.
+  says so (503) — accepted work always finishes;
+* admission is SLO-shaped (serve/shaping.py): the queue orders
+  earliest-deadline-first within a priority, ``pop_batch`` may hold a
+  few deadline-bounded milliseconds for predicted same-bucket arrivals
+  so steady traffic coalesces into larger batch rungs, 429s carry a
+  Retry-After derived from the observed service rate, and a job that
+  provably cannot meet its deadline at the current depth is shed at
+  submit instead of queued to miss.
 
 Threading model: HTTP handler threads (stdlib ``ThreadingHTTPServer``)
 only touch the queue / cache / jobs table; the device side is the
@@ -52,6 +59,7 @@ import dataclasses
 import itertools
 import json
 import logging
+import math
 import os
 import threading
 import time
@@ -73,9 +81,10 @@ from fastconsensus_tpu.serve.jobs import (PRIORITY_BATCH,
                                           STATE_DONE, STATE_FAILED,
                                           STATE_QUEUED, STATE_RUNNING, Job,
                                           JobSpec)
-from fastconsensus_tpu.serve.queue import (AdmissionQueue, QueueClosed,
-                                           QueueFull)
+from fastconsensus_tpu.serve.queue import (AdmissionQueue, DeadlineShed,
+                                           QueueClosed, QueueFull)
 from fastconsensus_tpu.serve.cache import ResultCache
+from fastconsensus_tpu.serve.shaping import ShapingConfig, TrafficShaper
 
 _logger = logging.getLogger("fastconsensus_tpu")
 
@@ -158,6 +167,12 @@ class ServeConfig:
     # batches leave their home device only when the home has more than
     # this many jobs queued.
     spill_backlog: int = 8
+    # SLO-aware traffic shaping (serve/shaping.py): EDF admission
+    # ordering, the adaptive hold-for-coalesce window, and
+    # deadline-aware shedding with derived Retry-After.  The default
+    # config enables all three arms; ShapingConfig is frozen, so the
+    # shared default instance is safe.
+    shaping: ShapingConfig = ShapingConfig()
 
 
 def validate_warm_specs(config: ServeConfig) -> None:
@@ -203,7 +218,11 @@ class ConsensusService:
 
     def __init__(self, config: Optional[ServeConfig] = None) -> None:
         self.config = config or ServeConfig()
-        self.queue = AdmissionQueue(self.config.queue_depth)
+        self.queue = AdmissionQueue(self.config.queue_depth,
+                                    edf=self.config.shaping.edf)
+        self.shaper = TrafficShaper(self.config.shaping)
+        if self.config.shaping.hold and self.config.max_batch > 1:
+            self.queue.set_shaper(self.shaper)
         self.cache = ResultCache(max_entries=self.config.cache_entries,
                                  ttl_seconds=self.config.cache_ttl_s)
         self._jobs: "OrderedDict[str, Job]" = OrderedDict()
@@ -257,6 +276,22 @@ class ConsensusService:
 
         self.pool = WorkerPool(self)
         self.pool.start()
+        # Retry-After / shed math divides queued work across the chips
+        # actually draining it; the callable re-counts per decision so
+        # cordoned workers stop flattering the estimate.
+        pool = self.pool
+        self.shaper.set_parallelism(
+            lambda: sum(1 for w in pool.chip_workers if w.eligible()))
+        # hold economics: pop_batch may hold only while every chip is
+        # already occupied (the held job would have waited in a deque
+        # anyway) — an idle chip turns every held millisecond into
+        # real added latency, so the shaper bypasses then
+        self.shaper.set_busy_probe(pool.chips_all_busy)
+        if self.config.chip_max_edges is not None:
+            # huge-tier buckets run SOLO on the mesh group whatever the
+            # pop size — holding them coalesces nothing
+            self.shaper.set_solo_probe(
+                lambda key: pool._is_huge(bucketer.bucket_from_key(key)))
         return self
 
     def begin_drain(self) -> None:
@@ -363,6 +398,7 @@ class ConsensusService:
                 f"graph has {n_raw} edges; this server admits at most "
                 f"{self.config.max_edges}")
         job = Job(self._normalize_spec(spec))
+        bucket_key = None
         try:
             # fclat per-bucket arrival rate: offered load, marked for
             # EVERY admissible request (cache hits included — the
@@ -370,7 +406,8 @@ class ConsensusService:
             # process, not the cache-filtered one).  canonical() is
             # already memoized by the content hash above, so bucket()
             # is just the grid lookup.
-            self._lat.arrivals.mark(job.spec.bucket().key())
+            bucket_key = job.spec.bucket().key()
+            self._lat.arrivals.mark(bucket_key)
         except Exception:  # noqa: BLE001 — rate tracking must never
             pass           # reject a job the bucketer will judge later
         cached = self.cache.get(job.key)
@@ -380,17 +417,47 @@ class ConsensusService:
             self._reg.inc("serve.jobs.cached")
             self._record_timeline(job, cached=True)
             return job
+        # fcshape deadline-aware shedding: a job the measured service
+        # rate provably cannot finish inside its SLO at the current
+        # depth is refused NOW — same 429 class as QueueFull, but the
+        # client learns in microseconds what the queue would have told
+        # it after the whole SLO window.  Cache hits never reach here
+        # (they cost no slot); cold-start estimates never shed.
+        if bucket_key is not None:
+            depth = self.queue.total_depth()
+            reason = self.shaper.should_shed(bucket_key,
+                                             job.deadline_mono, depth)
+            if reason is not None:
+                self._reg.inc("serve.queue.rejected_shed")
+                shed = DeadlineShed(depth, self.queue.max_depth, reason)
+                shed.retry_after_s = self.shaper.retry_after_s(
+                    depth, bucket_key)
+                raise shed
         try:
             # Pre-compute (memoize) the coalescing group HERE, on the
             # submitting thread: pop_batch evaluates group_key under
             # the queue lock, and a first evaluation there would run
             # the O(E log E) canonicalization for every heap entry
             # while all submits block.  (canonical() is already warm —
-            # the content hash above computed it.)
-            job.spec.batch_group()
+            # the content hash above computed it.)  The GROUP arrival
+            # mark is the hold predictor's preferred fill signal: only
+            # same-group arrivals can join a rung, so the per-bucket
+            # rate alone would predict fills mixed-config traffic can
+            # never deliver.
+            self._lat.group_arrivals.mark(job.spec.batch_group())
         except Exception:  # noqa: BLE001 — grouping must never reject
             pass           # a job; _group_key falls back to solo
-        self.queue.submit(job)   # QueueFull/QueueClosed propagate
+        try:
+            self.queue.submit(job)   # QueueClosed propagates as-is
+        except QueueFull as e:
+            # honest backpressure: the 429 tells the client when the
+            # depth it bounced off should actually have drained
+            try:
+                e.retry_after_s = self.shaper.retry_after_s(
+                    e.depth, bucket_key)
+            except Exception:  # noqa: BLE001 — estimator trouble must
+                pass           # never mask the backpressure signal
+            raise
         self._remember(job)
         return job
 
@@ -465,7 +532,20 @@ class ConsensusService:
             return
         tags = dict(bucket=bucket_key, rung=0 if cached else int(rung),
                     priority=job.spec.priority, device=device)
+        if not cached and (job.result or {}).get("compiles"):
+            # a cold job's device phase is mostly XLA compile time, not
+            # service: tag it so the shaping service-time estimator
+            # (obs/latency.py) can exclude it — one 50 s compile in the
+            # mean would make the deadline-shed math refuse jobs a warm
+            # bucket serves in 20 ms
+            tags["cold"] = 1
         for name, secs in phases.items():
+            if name == "hold" and secs <= 0.0:
+                # every popped job carries a hold stamp (jobs.py), but
+                # only actual hold-for-coalesce episodes belong in the
+                # serve.phase.hold histogram — a distribution that is
+                # 99% synthetic zeros measures nothing
+                continue
             self._lat.hist(f"serve.phase.{name}", **tags).record(secs)
         self._lat.hist("serve.e2e", **tags).record(e2e)
         verdict = "met" if e2e * 1000.0 <= job.spec.slo_target() \
@@ -491,6 +571,16 @@ class ConsensusService:
                 }
         snap["slo"] = slo
         return snap
+
+    def shaping_stats(self) -> Dict[str, Any]:
+        """The ``/metricsz`` ``shaping`` block (typed by the jax-free
+        client): the live shaping config, the ``serve.shape.*``
+        counters, per-bucket service-time estimates for every bucket
+        with arrival history, and the Retry-After a 429 issued at the
+        current depth would carry."""
+        buckets = sorted(self._lat.arrivals.rates())
+        return self.shaper.describe(depth=self.queue.total_depth(),
+                                    buckets=buckets)
 
     # -- the worker paths (driven by serve/pool.py workers) -----------
 
@@ -1070,10 +1160,21 @@ class _Handler(BaseHTTPRequestHandler):
             return
         except QueueFull as e:
             # THE backpressure response: explicit, immediate, retryable
+            # — and honest: Retry-After derives from queued depth x the
+            # observed per-bucket service rate (serve/shaping.py), not
+            # a literal guess.  The header is integer delta-seconds
+            # (RFC 9110, rounded up so it never under-promises); the
+            # body carries the unrounded float for typed clients.
+            retry_s = e.retry_after_s
+            if retry_s is None:
+                retry_s = self.service.shaper.config.retry_after_default_s
             self._send(429, {"error": str(e), "backpressure": True,
+                             "shed": isinstance(e, DeadlineShed),
+                             "retry_after_s": round(retry_s, 3),
                              "queue_depth": e.depth,
                              "queue_max_depth": e.max_depth},
-                       headers={"Retry-After": "1"})
+                       headers={"Retry-After":
+                                str(max(1, math.ceil(retry_s)))})
             return
         except QueueClosed as e:
             self._send(503, {"error": str(e), "draining": True})
@@ -1096,7 +1197,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, {"fcobs": self.service._reg.snapshot(),
                              "serve": self.service.stats(),
                              "devices": self.service.device_stats(),
-                             "latency": self.service.latency_stats()})
+                             "latency": self.service.latency_stats(),
+                             "shaping": self.service.shaping_stats()})
             return
         for prefix in ("/status/", "/result/"):
             if path.startswith(prefix):

@@ -626,7 +626,8 @@ fi
 # committed curve (the smoke artifact is unsequenced, so it informs the
 # table but never gates — exactly the ad-hoc-rerun contract)
 python scripts/bench_report.py --check --quiet \
-    "$SL_DIR/bench_serve_load_smoke.json" runs/bench_serve_load_r09.json
+    "$SL_DIR/bench_serve_load_smoke.json" \
+    runs/bench_serve_load_r09.json runs/bench_serve_load_r10.json
 rc=$?
 if [ $rc -ne 0 ]; then
     echo "bench_report --check failed on the serve_load smoke artifact" \
@@ -658,6 +659,258 @@ if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "tail-latency"; then
     exit 1
 fi
 echo "serve_load smoke ok: curve gated, regressed copy fails naming tail-latency"
+
+echo "== fcshape: traffic-shaping smoke (hold coalescing, EDF probe, honest 429) =="
+SHAPE_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR" "$SERVE_DIR" "$BATCH_DIR" "$POOL_DIR" "$AUTO_DIR" "$SL_DIR" "$SHAPE_DIR"; [ -n "$SERVE_PID" ] && kill -9 "$SERVE_PID" 2>/dev/null' EXIT
+# (1) hold-for-coalesce: the same stall-then-burst through a shaper-armed
+# queue must coalesce into a LARGER rung than the r09 no-hold posture
+# (which pops the paced burst as singles), and through the full service
+# the burst must land in batched device calls (occupancy counter
+# asserted) with at least one hold episode recorded (the outer timeout
+# must exceed the script's own 1200 s prewarm deadline, or a slow
+# prewarm dies as an opaque 124 instead of the named assertion)
+JAX_PLATFORMS=cpu timeout -k 10 1500 python - > "$SHAPE_DIR/shape.out" 2>&1 <<'PYEOF'
+import threading
+import time
+
+import numpy as np
+
+from fastconsensus_tpu.consensus import ConsensusConfig
+from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs import latency as obs_latency
+from fastconsensus_tpu.serve.jobs import Job, JobSpec
+from fastconsensus_tpu.serve.queue import AdmissionQueue
+from fastconsensus_tpu.serve.shaping import ShapingConfig, TrafficShaper
+
+edges = np.array([[0, 1], [1, 2], [2, 3]], dtype=np.int64)
+
+
+def mk(seed):
+    return Job(JobSpec(edges=edges, n_nodes=4,
+                       config=ConsensusConfig(seed=seed)))
+
+
+def gk(j):
+    return j.spec.batch_group()
+
+
+def stall_then_burst(shaped):
+    """Pace 6 same-group jobs 10 ms apart (after a stall) through
+    pop_batch; return the popped rung sizes."""
+    q = AdmissionQueue(64)
+    if shaped:
+        lat = obs_latency.LatencyRegistry()
+        now = time.monotonic()
+        bucket = mk(0).spec.bucket().key()
+        for k in range(32):     # primed arrival history: 100 jobs/s
+            lat.arrivals.mark(bucket, at=now - 0.01 * (32 - k))
+        q.set_shaper(TrafficShaper(
+            ShapingConfig(max_hold_s=0.2, hold_margin=3.0), lat=lat,
+            reg=obs_counters.get_registry()))
+    rungs = []
+
+    def consume():
+        while True:
+            b = q.pop_batch(4, gk)
+            if b is None:
+                return
+            rungs.append(len(b))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.2)             # the stall
+    for s in range(6):          # the burst
+        q.submit(mk(seed=s))
+        time.sleep(0.010)
+    q.close()
+    t.join(10.0)
+    assert sum(rungs) == 6, rungs
+    return rungs
+
+plain = stall_then_burst(shaped=False)
+shaped = stall_then_burst(shaped=True)
+print(f"no-hold rungs: {plain}  hold rungs: {shaped}")
+# the r09 posture pops the paced burst as singles (the consumer is
+# always parked on the next job before it arrives)...
+assert max(plain) == 1, plain
+# ...while the shaper coalesces a strictly larger rung
+assert max(shaped) >= 2, shaped
+since = obs_counters.get_registry().counters()
+assert since.get("serve.shape.holds", 0) >= 1, since
+assert since.get("serve.queue.coalesced_pops", 0) >= 1, since
+
+# -- full-service stall-then-burst: the occupancy counter must move ----
+from fastconsensus_tpu.serve import bucketer
+from fastconsensus_tpu.serve.client import ServeClient
+from fastconsensus_tpu.serve.server import (ConsensusService, ServeConfig,
+                                            make_http_server)
+
+bucket = bucketer.bucket_for(64, 96)
+probe = bucketer.probe_edges(bucket).tolist()
+svc = ConsensusService(ServeConfig(
+    queue_depth=64, pin_sizing=False, devices=1, max_batch=4,
+    prewarm=(f"{bucket.key()}:4",),
+    prewarm_config={"n_p": 4, "max_rounds": 2})).start()
+httpd = make_http_server(svc, "127.0.0.1", 0)
+threading.Thread(target=httpd.serve_forever, daemon=True).start()
+client = ServeClient(f"http://127.0.0.1:{httpd.server_address[1]}",
+                     timeout=30.0)
+deadline = time.monotonic() + 1200
+while not svc.stats()["prewarm"]["finished"]:
+    assert time.monotonic() < deadline, "prewarm never finished"
+    time.sleep(0.2)
+# estimator warm-up (two real jobs), then the stall, then the burst
+for s in (1000, 1001):
+    sub = client.submit(edges=probe, n_nodes=bucket.n_class,
+                        algorithm="louvain", n_p=4, max_rounds=2, seed=s)
+    client.wait(sub["job_id"], timeout=300)
+reg = obs_counters.get_registry()
+base = reg.counters()
+time.sleep(1.0)                 # the stall: ages the warmup arrivals
+jids = []                       # out of the rate horizon
+for s in range(2000, 2008):     # the burst: 8 jobs, back to back
+    jids.append(client.submit(
+        edges=probe, n_nodes=bucket.n_class, algorithm="louvain",
+        n_p=4, max_rounds=2, seed=s)["job_id"])
+for jid in jids:
+    client.wait(jid, timeout=300)
+since = reg.counters_since(base)
+occupancy = since.get("serve.batch.occupancy", 0)
+holds = since.get("serve.shape.holds", 0)
+print(f"burst: occupancy={occupancy} coalesced="
+      f"{since.get('serve.batch.coalesced', 0)} holds={holds}")
+assert occupancy >= 4, since    # the burst rode batched device calls
+assert holds >= 1, since        # ...because the dispatcher held for it
+sh = client.shaping()
+assert sh.holds >= 1 and sh.estimates, sh
+httpd.shutdown()
+httpd.server_close()
+assert svc.drain(300)
+print("shaping smoke ok: held burst coalesced (occupancy counter moved), "
+      "no-hold posture popped singles")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcshape smoke failed (exit $rc)" >&2
+    cat "$SHAPE_DIR/shape.out" >&2
+    exit 1
+fi
+grep "rungs:" "$SHAPE_DIR/shape.out"
+grep "shaping smoke ok" "$SHAPE_DIR/shape.out"
+
+# (2) deadline-inversion negative probe: the no-EDF posture must FAIL,
+# naming its check — a gate that cannot fail is no gate
+if JAX_PLATFORMS=cpu python - > "$SHAPE_DIR/edf.out" 2>&1 <<'PYEOF'
+import sys
+
+import numpy as np
+
+from fastconsensus_tpu.consensus import ConsensusConfig
+from fastconsensus_tpu.serve.jobs import Job, JobSpec
+from fastconsensus_tpu.serve.queue import AdmissionQueue
+from fastconsensus_tpu.serve.shaping import find_deadline_inversions
+
+edges = np.array([[0, 1], [1, 2]], dtype=np.int64)
+
+
+def mk(slo_ms, seed):
+    return Job(JobSpec(edges=edges, n_nodes=3,
+                       config=ConsensusConfig(seed=seed),
+                       slo_target_ms=slo_ms))
+
+q = AdmissionQueue(8, edf=False)    # the pre-fcshape FIFO posture
+q.submit(mk(60_000.0, 1))
+q.submit(mk(20.0, 2))               # tight deadline, admitted second
+log = [q.pop(), q.pop()]
+problems = find_deadline_inversions(log)
+for p in problems:
+    print(p)
+sys.exit(1 if problems else 0)
+PYEOF
+then
+    echo "no-EDF deadline-inversion probe unexpectedly passed:" >&2
+    cat "$SHAPE_DIR/edf.out" >&2
+    exit 1
+fi
+if ! grep -q "deadline-inversion" "$SHAPE_DIR/edf.out"; then
+    echo "no-EDF probe failed without naming deadline-inversion:" >&2
+    cat "$SHAPE_DIR/edf.out" >&2
+    exit 1
+fi
+echo "deadline-inversion probe ok: FIFO posture fails naming its check"
+
+# (3) a 429 must carry a NUMERIC Retry-After (header integer
+# delta-seconds; body float; typed client field) — the literal "1" era
+# is over
+JAX_PLATFORMS=cpu timeout -k 10 300 python - > "$SHAPE_DIR/bp.out" 2>&1 <<'PYEOF'
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from fastconsensus_tpu.consensus import ConsensusConfig
+from fastconsensus_tpu.obs import latency as obs_latency
+from fastconsensus_tpu.serve.client import Backpressure, ServeClient
+from fastconsensus_tpu.serve.jobs import JobSpec
+from fastconsensus_tpu.serve.server import (ConsensusService, ServeConfig,
+                                            make_http_server)
+
+edges = [[0, 1], [1, 2], [2, 3]]
+spec = JobSpec(edges=np.asarray(edges, dtype=np.int64), n_nodes=4,
+               config=ConsensusConfig())
+bucket_key = spec.bucket().key()
+lat = obs_latency.get_latency_registry()
+for _ in range(16):             # measured service history: ~90 ms/job
+    for phase in ("pack", "device", "fanout"):
+        lat.hist(f"serve.phase.{phase}", bucket=bucket_key,
+                 rung=1).record(0.030)
+# no pool started: the queue fills deterministically
+svc = ConsensusService(ServeConfig(queue_depth=2))
+httpd = make_http_server(svc, "127.0.0.1", 0)
+threading.Thread(target=httpd.serve_forever, daemon=True).start()
+url = f"http://127.0.0.1:{httpd.server_address[1]}"
+client = ServeClient(url, timeout=10.0)
+caught = None
+for seed in range(8):
+    try:
+        client.submit(edges=edges, n_nodes=4, algorithm="louvain",
+                      seed=seed)
+    except Backpressure as e:
+        caught = e
+        break
+assert caught is not None, "queue_depth=2 never backpressured"
+assert isinstance(caught.retry_after_s, float)
+assert caught.retry_after_s > 0.0
+assert caught.payload.get("retry_after_s") is not None
+# and the raw header is numeric delta-seconds
+req = urllib.request.Request(
+    url + "/submit",
+    data=json.dumps({"edges": edges, "n_nodes": 4,
+                     "algorithm": "louvain", "seed": 99}).encode(),
+    headers={"Content-Type": "application/json"})
+try:
+    urllib.request.urlopen(req, timeout=10)
+    raise AssertionError("expected 429")
+except urllib.error.HTTPError as e:
+    assert e.code == 429, e.code
+    header = e.headers.get("Retry-After")
+    assert header is not None, "429 without Retry-After"
+    assert int(header) >= 1, header      # numeric, never the old guess
+print(f"429 retry_after_s={caught.retry_after_s} header ok")
+httpd.shutdown()
+httpd.server_close()
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcshape 429 Retry-After probe failed (exit $rc)" >&2
+    cat "$SHAPE_DIR/bp.out" >&2
+    exit 1
+fi
+grep "header ok" "$SHAPE_DIR/bp.out"
+echo "fcshape smoke ok: coalescing, EDF gate, honest backpressure"
 
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"

@@ -173,7 +173,7 @@ def test_bench_report_cli_no_records_is_an_error(tmp_path):
 
 
 def _serve_load_artifact(p95=20.0, attainment=1.0, rejected=0,
-                         ref_rps=2.0):
+                         ref_rps=2.0, mix=None):
     def point(rps, scale):
         met = int(round(30 * attainment))
         return {"rps": rps, "seconds": 8.0, "submitted": 30,
@@ -199,6 +199,7 @@ def _serve_load_artifact(p95=20.0, attainment=1.0, rejected=0,
                           "phase_consistency_frac": 0.0,
                           "serve_load": {"reference_rps": ref_rps,
                                          "slo_class": "interactive",
+                                         "mix": mix,
                                          "queue_depth": 32,
                                          "max_batch": 4,
                                          "points": [point(ref_rps, 1.0),
@@ -249,6 +250,19 @@ def test_check_serve_load_gates_tail_latency(tmp_path):
     moved = one + [_write_serve_load(tmp_path, 10, p95=200.0,
                                      ref_rps=8.0)]
     assert history.check_serve_load(history.build_history(moved)) == []
+    # fcshape: a sweep whose SLO-class MIX changed has no prior anchor
+    # either — a mixed workload queues differently by design, so its
+    # p95 must not be judged against single-class (mix None) priors
+    mixed = one + [_write_serve_load(
+        tmp_path, 10, p95=200.0, mix="interactive:0.5,batch:0.5")]
+    assert history.check_serve_load(history.build_history(mixed)) == []
+    # while a same-mix regression still gates
+    same_mix = [_write_serve_load(tmp_path, 9,
+                                  mix="interactive:0.5,batch:0.5"),
+                _write_serve_load(tmp_path, 10, p95=200.0,
+                                  mix="interactive:0.5,batch:0.5")]
+    probs = history.check_serve_load(history.build_history(same_mix))
+    assert len(probs) == 1 and "tail-latency" in probs[0]
 
 
 def test_check_history_never_inverts_on_latency_artifacts(tmp_path):

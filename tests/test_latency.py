@@ -201,14 +201,19 @@ def test_phase_sum_equals_e2e_with_missing_stamps():
     """Phases are consecutive differences of one monotonic clock, so
     their sum equals the end-to-end latency BY CONSTRUCTION, whatever
     subset of stamps a path recorded (cache hits never pack, solo jobs
-    never batch...)."""
+    never batch...).  Every pop path stamps hold_start alongside
+    dispatched (jobs.py stamp_hold), so the real-path subset always
+    includes both."""
+    import time as _time
+
     job = _job()
+    job.stamp_hold(_time.monotonic())
     job.stamp("dispatched")
     job.stamp("dequeued")       # no "enqueued": folds into deque_wait
     job.stamp("device_done")    # no "packed": folds into device
     job.mark("done", result={})
     t = job.timing()
-    assert set(t["phases_ms"]) == {"queue_wait", "deque_wait",
+    assert set(t["phases_ms"]) == {"queue_wait", "hold", "deque_wait",
                                    "device", "respond"}
     assert t["phase_sum_ms"] == pytest.approx(t["e2e_ms"], abs=0.01)
 

@@ -247,13 +247,19 @@ def test_busy_worker_recoalesces_deque_burst_into_one_batch():
     busy, the eager dispatcher parks a same-group burst as single-job
     deque batches — the worker must re-merge them into ONE batched
     device call (serve.pool.deque_coalesced), or PR 5's coalescing
-    would only survive a deep admission heap."""
+    would only survive a deep admission heap.  Runs the no-hold
+    posture deliberately: with fcshape holding on, the dispatcher
+    coalesces this burst upstream at the admission heap and the deque
+    re-merge layer (still the only coalescer when holds are off or
+    bypassed) would go unexercised."""
     from fastconsensus_tpu.obs import counters as obs_counters
     from fastconsensus_tpu.serve.server import (ConsensusService,
                                                 ServeConfig)
+    from fastconsensus_tpu.serve.shaping import ShapingConfig
 
-    svc = ConsensusService(ServeConfig(queue_depth=16, pin_sizing=False,
-                                       devices=1, max_batch=4)).start()
+    svc = ConsensusService(ServeConfig(
+        queue_depth=16, pin_sizing=False, devices=1, max_batch=4,
+        shaping=ShapingConfig(hold=False))).start()
     w = svc.pool.chip_workers[0]
     entered, release = threading.Event(), threading.Event()
     orig = w._run

@@ -1,0 +1,602 @@
+"""fcshape traffic-shaping tests (serve/shaping.py + the EDF queue).
+
+Covers the ISSUE-10 satellite contracts: EDF ordering pinned under 4
+submitting threads (no deadline inversion within a priority), the
+hold-window bound (a hold never exceeds the deadline slack; a lone
+tight-deadline job dispatches immediately), a deterministic fake-clock
+unit for the time-to-fill predictor, honest Retry-After derivation and
+its typed parse in the jax-free client, and deadline-aware shedding.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def _spec(prio=1, slo_ms=None, seed=0, slo=None):
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.jobs import JobSpec
+
+    return JobSpec(edges=np.array([[0, 1], [1, 2], [2, 3]],
+                                  dtype=np.int64),
+                   n_nodes=4, config=ConsensusConfig(seed=seed),
+                   priority=prio, slo=slo, slo_target_ms=slo_ms)
+
+
+def _job(**kw):
+    from fastconsensus_tpu.serve.jobs import Job
+
+    return Job(_spec(**kw))
+
+
+def _fresh_lat():
+    from fastconsensus_tpu.obs.latency import LatencyRegistry
+
+    return LatencyRegistry()
+
+
+def test_batch_ladder_mirror_matches_bucketer():
+    """The shaper's jax-free ladder mirror must equal the real one —
+    same contract as the footprint analyzer's grid mirror."""
+    from fastconsensus_tpu.serve import bucketer, shaping
+
+    assert shaping.BATCH_LADDER == bucketer.BATCH_LADDER
+
+
+# -- EDF ordering ------------------------------------------------------
+
+
+def test_edf_orders_by_deadline_within_priority():
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.queue import AdmissionQueue
+    from fastconsensus_tpu.serve.shaping import find_deadline_inversions
+
+    reg = obs_counters.get_registry()
+    base = reg.counters()
+    q = AdmissionQueue(8)
+    loose = _job(slo_ms=60_000.0, seed=1)
+    tight = _job(slo_ms=20.0, seed=2)     # admitted later, pops first
+    q.submit(loose)
+    q.submit(tight)
+    log = [q.pop(), q.pop()]
+    assert log == [tight, loose]
+    assert find_deadline_inversions(log) == []
+    since = reg.counters_since(base)
+    assert since.get("serve.shape.edf_promotions", 0) >= 1
+
+
+def test_priority_still_dominates_deadline():
+    """EDF orders WITHIN a priority only: a batch-priority job with a
+    tight deadline never jumps an interactive job with a loose one."""
+    from fastconsensus_tpu.serve.jobs import (PRIORITY_BATCH,
+                                              PRIORITY_INTERACTIVE)
+    from fastconsensus_tpu.serve.queue import AdmissionQueue
+
+    q = AdmissionQueue(8)
+    batch_tight = _job(prio=PRIORITY_BATCH, slo_ms=5.0, seed=1)
+    inter_loose = _job(prio=PRIORITY_INTERACTIVE, slo_ms=60_000.0,
+                       seed=2)
+    q.submit(batch_tight)
+    q.submit(inter_loose)
+    assert q.pop() is inter_loose
+
+
+def test_no_edf_posture_shows_the_inversion():
+    """The CI negative probe's substance: with edf=False the queue is
+    FIFO and the checker must FAIL, naming deadline-inversion — a gate
+    that cannot fail is no gate."""
+    from fastconsensus_tpu.serve.queue import AdmissionQueue
+    from fastconsensus_tpu.serve.shaping import find_deadline_inversions
+
+    q = AdmissionQueue(8, edf=False)
+    loose = _job(slo_ms=60_000.0, seed=1)
+    tight = _job(slo_ms=20.0, seed=2)
+    q.submit(loose)
+    q.submit(tight)
+    log = [q.pop(), q.pop()]
+    problems = find_deadline_inversions(log)
+    assert problems and "deadline-inversion" in problems[0]
+
+
+def test_edf_order_under_contention():
+    """The satellite pin: 4 submitting threads race jobs with random
+    SLO targets and priorities into the queue; the drained pop order
+    must show no deadline inversion within any priority."""
+    from fastconsensus_tpu.serve.queue import AdmissionQueue
+    from fastconsensus_tpu.serve.shaping import find_deadline_inversions
+
+    q = AdmissionQueue(256)
+    rng = np.random.default_rng(7)
+    targets = [[float(t) for t in rng.uniform(5.0, 5_000.0, size=40)]
+               for _ in range(4)]
+    prios = [[int(p) for p in rng.integers(0, 3, size=40)]
+             for _ in range(4)]
+    barrier = threading.Barrier(4)
+
+    def submitter(i):
+        barrier.wait()
+        for j, (ms, prio) in enumerate(zip(targets[i], prios[i])):
+            q.submit(_job(prio=prio, slo_ms=ms, seed=i * 1000 + j))
+
+    threads = [threading.Thread(target=submitter, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    log = []
+    while True:
+        job = q.pop(timeout=0.1)
+        if job is None:
+            break
+        log.append(job)
+    assert len(log) == 160
+    assert find_deadline_inversions(log) == []
+    # and the full order is exactly the heap contract
+    keys = [(j.spec.priority, j.deadline_mono) for j in log]
+    assert keys == sorted(keys)
+
+
+# -- the time-to-fill predictor (deterministic fake clock) -------------
+
+
+def test_expected_fill_predictor():
+    from fastconsensus_tpu.serve.shaping import expected_fill_s
+
+    assert expected_fill_s(1, 4, 10.0) == pytest.approx(0.3)
+    assert expected_fill_s(3, 4, 2.0) == pytest.approx(0.5)
+    assert expected_fill_s(4, 4, 2.0) == 0.0          # already full
+    assert expected_fill_s(1, 2, 0.0) == float("inf")  # idle bucket
+
+
+def test_predictor_over_fake_clock_rates():
+    """End-to-end predictor unit on explicit stamps: a RateTracker fed
+    marks at fake times yields an exact rate, and the fill prediction
+    follows — no wall clock anywhere."""
+    from fastconsensus_tpu.obs.latency import RateTracker
+    from fastconsensus_tpu.serve.shaping import expected_fill_s
+
+    rt = RateTracker()
+    for k in range(5):
+        rt.mark("b", at=100.0 + 0.1 * k)   # 10 arrivals/s burst
+    rate = rt.rate("b", now=100.5)
+    assert rate == pytest.approx(8.0)      # 4 intervals over 0.5 s
+    assert expected_fill_s(1, 3, rate) == pytest.approx(0.25)
+    # the recency-horizon contract: once the horizon empties, the rate
+    # reads 0.0 — an idle bucket must never promise ride-alongs
+    assert rt.rate("b", now=102.0) == 0.0
+    # a stale spell followed by a fresh burst: only the burst counts
+    rt.mark("b", at=110.0)
+    rt.mark("b", at=110.01)
+    assert rt.rate("b", now=110.02) == pytest.approx(50.0, rel=0.1)
+    # fewer than two marks in the horizon -> no rate, infinite fill
+    rt2 = RateTracker()
+    rt2.mark("c", at=100.0)
+    assert rt2.rate("c", now=100.1) == 0.0
+
+
+def test_next_rung():
+    from fastconsensus_tpu.serve.shaping import next_rung
+
+    assert next_rung(1, 8) == 2
+    assert next_rung(2, 8) == 4
+    assert next_rung(3, 4) == 4
+    assert next_rung(4, 4) is None
+    assert next_rung(1, 1) is None
+
+
+# -- hold decisions ----------------------------------------------------
+
+
+def _shaper(lat=None, **cfg_over):
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.shaping import (ShapingConfig,
+                                                 TrafficShaper)
+
+    return TrafficShaper(ShapingConfig(**cfg_over),
+                         lat=lat if lat is not None else _fresh_lat(),
+                         reg=obs_counters.get_registry())
+
+
+def _prime_service(lat, bucket="n64_e96", secs=0.010, n=16):
+    for _ in range(n):
+        for phase in ("pack", "device", "fanout"):
+            lat.hist(f"serve.phase.{phase}", bucket=bucket,
+                     rung=1).record(secs)
+
+
+def test_hold_never_exceeds_deadline_slack():
+    """The satellite bound: whatever the arrival rate promises, the
+    hold window is capped by (tightest deadline - now - service
+    estimate) — and a negative slack is an instant bypass."""
+    lat = _fresh_lat()
+    _prime_service(lat, secs=0.010)       # p95 estimate ~= 30 ms
+    now = time.monotonic()
+    for k in range(8):
+        lat.arrivals.mark("n64_e96", at=now - 0.01 * (8 - k))
+    sh = _shaper(lat=lat, max_hold_s=10.0)  # cap deliberately huge
+    slack = 0.050
+    d = sh.hold_decision("n64_e96", have=1, max_b=8, slack_s=slack,
+                         now=now)
+    assert d.hold_s <= slack              # never past the slack
+    assert d.hold_s <= slack - 0.029      # service estimate subtracted
+    # lone tight-deadline job: slack below the service estimate ->
+    # bypass, zero added latency
+    d = sh.hold_decision("n64_e96", have=1, max_b=8, slack_s=0.005,
+                         now=now)
+    assert d.hold_s == 0.0 and d.reason == "deadline"
+
+
+def test_hold_proportional_to_fill_and_bypass_when_unfillable():
+    lat = _fresh_lat()
+    now = time.monotonic()
+    for k in range(16):
+        lat.arrivals.mark("b", at=now - 0.005 * (16 - k))  # 200/s
+    sh = _shaper(lat=lat, max_hold_s=0.050, hold_margin=1.5)
+    d = sh.hold_decision("b", have=1, max_b=8, slack_s=10.0, now=now)
+    assert d.reason == "hold" and d.target == 2
+    assert d.hold_s == pytest.approx(1.5 / 200.0, rel=0.1)
+    # a bucket with no arrival history can never fill a rung: bypass
+    d = sh.hold_decision("cold", have=1, max_b=8, slack_s=10.0, now=now)
+    assert d.hold_s == 0.0 and d.reason == "fill_exceeds_slack"
+    # a full rung never holds
+    d = sh.hold_decision("b", have=8, max_b=8, slack_s=10.0, now=now)
+    assert d.hold_s == 0.0 and d.reason == "rung_full"
+
+
+def test_solo_tier_and_cordoned_pool_never_hold():
+    """A mesh/huge-tier bucket executes solo whatever the pop size —
+    holding it coalesces nothing; and a pool with NO eligible chip
+    (all cordoned) must not report holding as free (all([]) trap)."""
+    lat = _fresh_lat()
+    now = time.monotonic()
+    for k in range(16):
+        lat.arrivals.mark("huge", at=now - 0.005 * (16 - k))
+    sh = _shaper(lat=lat, max_hold_s=0.5)
+    sh.set_solo_probe(lambda b: b == "huge")
+    d = sh.hold_decision("huge", have=1, max_b=8, slack_s=100.0,
+                         now=now)
+    assert d.hold_s == 0.0 and d.reason == "solo_tier"
+    # same traffic on a chip-tier bucket still holds
+    for k in range(16):
+        lat.arrivals.mark("chip", at=now - 0.005 * (16 - k))
+    d = sh.hold_decision("chip", have=1, max_b=8, slack_s=100.0,
+                         now=now)
+    assert d.reason == "hold"
+    # an empty eligible-chip set is NOT "everyone is busy"
+    from fastconsensus_tpu.serve.pool import WorkerPool
+
+    class _Cordoned:
+        def eligible(self, exclude=frozenset()):
+            return False
+
+    pool = WorkerPool.__new__(WorkerPool)
+    pool.chip_workers = [_Cordoned()]
+    assert pool.chips_all_busy() is False
+
+
+def test_fill_prediction_prefers_group_rate():
+    """Only same-group arrivals can join a rung: with mixed-config
+    traffic on one bucket, the bucket rate predicts fills that can
+    never happen — the group tracker must win when it has history."""
+    lat = _fresh_lat()
+    now = time.monotonic()
+    for k in range(32):                   # hot bucket: 200 jobs/s...
+        lat.arrivals.mark("b", at=now - 0.005 * (32 - k))
+    for k in range(8):                    # ...but THIS group: 4/s
+        lat.group_arrivals.mark("g1", at=now - 0.25 * (8 - k))
+    sh = _shaper(lat=lat, max_hold_s=0.050)
+    d = sh.hold_decision("b", have=1, max_b=8, slack_s=100.0, now=now,
+                         group="g1")
+    # group fill = 1/4 s >> 50 ms cap: bypass, despite the hot bucket
+    assert d.hold_s == 0.0 and d.reason == "fill_exceeds_slack"
+    # a group with no history falls back to the bucket rate and holds
+    d = sh.hold_decision("b", have=1, max_b=8, slack_s=100.0, now=now,
+                         group="g-unseen")
+    assert d.reason == "hold"
+
+
+def test_group_switch_mid_hold_does_not_pollute_hold_stamp():
+    """A tighter-deadline job of another group that takes the head
+    mid-hold pops immediately — and must NOT inherit the aborted
+    episode's start stamp (its group never held)."""
+    from fastconsensus_tpu.serve.queue import AdmissionQueue
+
+    lat = _fresh_lat()
+    now = time.monotonic()
+    a = _job(slo_ms=60_000.0, seed=1)
+    bucket_key = a.spec.bucket().key()
+    group_a = a.spec.batch_group()
+    for k in range(16):
+        lat.group_arrivals.mark(group_a, at=now - 0.02 * (16 - k))
+        lat.arrivals.mark(bucket_key, at=now - 0.02 * (16 - k))
+    q = AdmissionQueue(8)
+    q.set_shaper(_shaper(lat=lat, max_hold_s=0.5, hold_margin=10.0))
+    q.submit(a)                           # head: starts a long hold
+    got = {}
+
+    def consume():
+        got["b1"] = q.pop_batch(8, lambda j: j.spec.batch_group())
+        got["b2"] = q.pop_batch(8, lambda j: j.spec.batch_group())
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.05)                      # a is mid-hold
+    # different group (different n_p via config), tighter deadline:
+    # takes the head, its decision has no group history -> bypasses
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.jobs import Job, JobSpec
+
+    b = Job(JobSpec(edges=np.array([[0, 1], [1, 2], [2, 3]],
+                                   dtype=np.int64),
+                    n_nodes=4, config=ConsensusConfig(n_p=7, seed=99),
+                    slo_target_ms=10.0))
+    q.submit(b)
+    t.join(5.0)
+    first = got["b1"]
+    assert first == [b]                   # EDF: b preempted the head
+    b.mark("done", result={})
+    assert b.timing()["phases_ms"]["hold"] <= 0.011
+    assert got["b2"][0] is a
+
+
+def test_lone_tight_deadline_job_dispatches_immediately():
+    """Integration form of the bound: a shaper-armed queue holding a
+    single job whose deadline slack is gone pops it with no wait."""
+    from fastconsensus_tpu.serve.queue import AdmissionQueue
+
+    lat = _fresh_lat()
+    now = time.monotonic()
+    bucket_key = _spec().bucket().key()
+    for k in range(16):
+        lat.arrivals.mark(bucket_key, at=now - 0.005 * (16 - k))
+    q = AdmissionQueue(8)
+    q.set_shaper(_shaper(lat=lat, max_hold_s=0.5))
+    q.submit(_job(slo_ms=1.0, seed=1))    # deadline already ~expired
+    t0 = time.monotonic()
+    batch = q.pop_batch(8, lambda j: j.spec.batch_group())
+    took = time.monotonic() - t0
+    assert len(batch) == 1
+    assert took < 0.1                     # nowhere near max_hold_s
+
+
+def test_pop_batch_holds_to_coalesce_and_stamps_hold_phase():
+    """A shaper-armed pop_batch waits for predicted ride-alongs, the
+    coalesced batch comes out bigger, and every member's fclat
+    timeline carries the hold as its own phase (sum still == e2e)."""
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.queue import AdmissionQueue
+
+    reg = obs_counters.get_registry()
+    base = reg.counters()
+    lat = _fresh_lat()
+    now = time.monotonic()
+    bucket_key = _spec().bucket().key()
+    for k in range(32):
+        lat.arrivals.mark(bucket_key, at=now - 0.01 * (32 - k))  # 100/s
+    q = AdmissionQueue(16)
+    q.set_shaper(_shaper(lat=lat, max_hold_s=0.3, hold_margin=3.0))
+    gk = lambda j: j.spec.batch_group()  # noqa: E731
+    q.submit(_job(seed=1))
+    got = {}
+
+    def consume():
+        got["batch"] = q.pop_batch(4, gk)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    time.sleep(0.02)                      # inside the hold window
+    for s in (2, 3, 4):
+        q.submit(_job(seed=s))
+    t.join(5.0)
+    batch = got["batch"]
+    assert len(batch) == 4
+    since = reg.counters_since(base)
+    assert since.get("serve.shape.holds", 0) >= 1
+    head = batch[0]
+    head.mark("done", result={})
+    timing = head.timing()
+    assert timing["phases_ms"]["hold"] > 0.0
+    assert timing["phase_sum_ms"] == pytest.approx(timing["e2e_ms"],
+                                                   abs=0.01)
+    # a ride-along admitted mid-hold attributes only ITS share
+    late = batch[-1]
+    late.mark("done", result={})
+    lt = late.timing()
+    assert lt["phases_ms"]["hold"] <= timing["phases_ms"]["hold"] + 0.01
+    assert lt["phase_sum_ms"] == pytest.approx(lt["e2e_ms"], abs=0.01)
+
+
+def test_unheld_pop_has_zero_hold_phase():
+    from fastconsensus_tpu.serve.queue import AdmissionQueue
+
+    q = AdmissionQueue(8)                 # no shaper installed
+    q.submit(_job(seed=1))
+    job = q.pop()
+    job.mark("done", result={})
+    t = job.timing()
+    assert t["phases_ms"]["hold"] == 0.0
+    assert "queue_wait" in t["phases_ms"]
+
+
+def test_closed_queue_never_holds():
+    """Drain beats occupancy: close() during (or before) a hold pops
+    whatever is queued immediately."""
+    from fastconsensus_tpu.serve.queue import AdmissionQueue
+
+    lat = _fresh_lat()
+    now = time.monotonic()
+    bucket_key = _spec().bucket().key()
+    for k in range(32):
+        lat.arrivals.mark(bucket_key, at=now - 0.01 * (32 - k))
+    q = AdmissionQueue(8)
+    q.set_shaper(_shaper(lat=lat, max_hold_s=5.0, hold_margin=50.0))
+    q.submit(_job(seed=1))
+    q.close()
+    t0 = time.monotonic()
+    batch = q.pop_batch(8, lambda j: j.spec.batch_group())
+    assert len(batch) == 1
+    assert time.monotonic() - t0 < 0.5
+    assert q.pop_batch(8, lambda j: j.spec.batch_group()) is None
+
+
+# -- service-time estimator + honest Retry-After -----------------------
+
+
+def test_service_estimate_sums_phases_and_skips_cache_hits():
+    lat = _fresh_lat()
+    lat.hist("serve.phase.pack", bucket="b", rung=1).record(0.002)
+    lat.hist("serve.phase.device", bucket="b", rung=1).record(0.010)
+    lat.hist("serve.phase.fanout", bucket="b", rung=1).record(0.001)
+    # cache-hit (rung 0) and queueing phases must not pollute it
+    lat.hist("serve.phase.device", bucket="b", rung=0).record(9.0)
+    lat.hist("serve.phase.queue_wait", bucket="b", rung=1).record(9.0)
+    est = lat.service_estimate("b")
+    assert est["count"] == 1
+    assert est["mean_s"] == pytest.approx(0.013, rel=0.01)
+    assert est["p95_s"] >= est["mean_s"]
+    assert lat.service_estimate("unseen-bucket") is None
+
+
+def test_service_estimate_excludes_cold_compiles_and_shed_no_fallback():
+    """A first-in-bucket job's device phase is mostly XLA compile; one
+    50 s compile in the mean would make should_shed refuse jobs a warm
+    bucket serves in milliseconds (the tier-1 false-shed regression).
+    Cold-tagged samples stay out of the estimate, and shedding never
+    borrows another bucket's service time."""
+    lat = _fresh_lat()
+    lat.hist("serve.phase.device", bucket="b", rung=1,
+             cold=1).record(50.0)          # the compile-inflated job
+    for _ in range(16):
+        lat.hist("serve.phase.device", bucket="b", rung=1).record(0.010)
+    est = lat.service_estimate("b")
+    assert est["count"] == 16
+    assert est["mean_s"] == pytest.approx(0.010, rel=0.01)
+    # shed: per-bucket history only — a bucket with no history never
+    # sheds, even when other buckets have plenty
+    sh = _shaper(lat=lat, min_estimate_count=8)
+    now = time.monotonic()
+    assert sh.should_shed("unseen", now + 0.001, depth=50,
+                          now=now) is None
+    # while hold/retry math may still borrow the all-bucket view
+    assert sh.service_estimate("unseen") is not None
+    assert sh.service_estimate("unseen", fallback=False) is None
+
+
+def test_retry_after_derivation_and_defaults():
+    lat = _fresh_lat()
+    sh = _shaper(lat=lat, min_estimate_count=8)
+    # no estimate yet: the honest default
+    assert sh.retry_after_s(10) == 1.0
+    _prime_service(lat, bucket="b", secs=0.010, n=16)
+    sh2 = _shaper(lat=lat, min_estimate_count=8)
+    # depth x mean service (30 ms/job) over 1 worker
+    assert sh2.retry_after_s(10, "b") == pytest.approx(0.30, rel=0.05)
+    sh2.set_parallelism(lambda: 4)
+    sh3 = _shaper(lat=lat, min_estimate_count=8)
+    sh3.set_parallelism(lambda: 4)
+    assert sh3.retry_after_s(10, "b") == pytest.approx(0.075, rel=0.05)
+
+
+def test_client_retry_after_parse_defaults():
+    """Typed Backpressure.retry_after_s: body float wins, header next,
+    absent/malformed falls back to the documented default."""
+    from fastconsensus_tpu.serve.client import (DEFAULT_RETRY_AFTER_S,
+                                                _retry_after_s)
+
+    assert _retry_after_s("3", {}) == 3.0
+    assert _retry_after_s("2", {"retry_after_s": 1.7}) == 1.7
+    assert _retry_after_s(None, {}) == DEFAULT_RETRY_AFTER_S
+    assert _retry_after_s("soon", {}) == DEFAULT_RETRY_AFTER_S
+    assert _retry_after_s("-5", {"retry_after_s": "junk"}) \
+        == DEFAULT_RETRY_AFTER_S
+
+
+# -- deadline shedding -------------------------------------------------
+
+
+def test_should_shed_only_when_provably_late():
+    from fastconsensus_tpu.obs import counters as obs_counters
+
+    reg = obs_counters.get_registry()
+    base = reg.counters()
+    lat = _fresh_lat()
+    _prime_service(lat, bucket="b", secs=0.050, n=16)  # 150 ms/job
+    sh = _shaper(lat=lat, min_estimate_count=8)
+    now = time.monotonic()
+    # 20 queued x 150 ms = 3 s of work; a 500 ms deadline is hopeless
+    reason = sh.should_shed("b", now + 0.5, depth=20, now=now)
+    assert reason is not None and "deadline shed" in reason
+    since = reg.counters_since(base)
+    assert since.get("serve.shape.deadline_sheds", 0) == 1
+    # the same depth with a 60 s deadline sails through
+    assert sh.should_shed("b", now + 60.0, depth=20, now=now) is None
+    # an empty queue never sheds
+    assert sh.should_shed("b", now + 0.5, depth=0, now=now) is None
+    # cold estimator never sheds
+    cold = _shaper(lat=_fresh_lat(), min_estimate_count=8)
+    assert cold.should_shed("b", now + 0.001, depth=50, now=now) is None
+
+
+def test_service_submit_sheds_and_answers_retry_after(monkeypatch):
+    """End-to-end shed at the service layer: with a primed estimator
+    and a deep queue, a tight-deadline submit raises DeadlineShed
+    (-> HTTP 429) carrying a derived retry_after_s; QueueFull carries
+    one too."""
+    from fastconsensus_tpu.serve.queue import DeadlineShed, QueueFull
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(queue_depth=8))
+    svc._lat.reset()   # the process-global fclat registry: earlier
+    bucket_key = _spec().bucket().key()   # tests primed this bucket
+    _prime_service(svc._lat, bucket=bucket_key, secs=0.200, n=16)
+    # no pool started: submits queue up and nothing drains
+    for s in range(6):
+        svc.submit(_spec(seed=s, slo_ms=600_000.0))
+    with pytest.raises(DeadlineShed) as ei:
+        svc.submit(_spec(seed=100, slo_ms=10.0))
+    assert ei.value.retry_after_s is not None
+    assert ei.value.retry_after_s > 0.0
+    # fill the queue with loose-deadline work -> plain QueueFull, also
+    # carrying the derived retry
+    for s in range(200, 210):
+        try:
+            svc.submit(_spec(seed=s, slo_ms=600_000.0))
+        except QueueFull as e:
+            assert not isinstance(e, DeadlineShed)
+            assert e.retry_after_s is not None and e.retry_after_s > 0
+            break
+    else:
+        pytest.fail("queue never filled")
+
+
+# -- /metricsz shaping block (typed, jax-free client) ------------------
+
+
+def test_shaping_block_schema_and_typed_parse():
+    from fastconsensus_tpu.serve.client import ShapingStats
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(queue_depth=8))
+    svc._lat.reset()   # isolate from earlier tests' global priming
+    bucket_key = _spec().bucket().key()
+    _prime_service(svc._lat, bucket=bucket_key, secs=0.010, n=16)
+    svc.submit(_spec(seed=1))             # marks the arrival tracker
+    block = svc.shaping_stats()
+    assert set(block) == {"config", "counters", "estimates",
+                          "retry_after_hint_s"}
+    assert set(block["counters"]) == {"holds", "bypass",
+                                      "edf_promotions",
+                                      "deadline_sheds"}
+    assert bucket_key in block["estimates"]
+    typed = ShapingStats.from_payload(block)
+    assert typed.edf and typed.hold and typed.shed
+    assert typed.max_hold_s == svc.config.shaping.max_hold_s
+    assert typed.estimates[bucket_key]["count"] == 16
+    assert typed.retry_after_hint_s is not None
