@@ -146,9 +146,31 @@ def entry_points() -> List[EntryPoint]:
                       jnp.zeros((16, 8), jnp.int32),
                       jnp.ones((16, 8), jnp.float32))),
         EntryPoint("engine.consensus_tail",
-                   mk(lambda s, lb, k: consensus_tail(
-                       s, lb, k, N_P, 0.2, 0.02, 32), slab, labels,
-                      jax.random.fold_in(key, 3))),
+                   # prev_labels operand included: every production call
+                   # site (consensus.py / serve) passes it since fcqual,
+                   # so the audited trace is the served executable
+                   mk(lambda s, lb, k, pl: consensus_tail(
+                       s, lb, k, N_P, 0.2, 0.02, 32, prev_labels=pl),
+                      slab, labels, jax.random.fold_in(key, 3), labels)),
+    ]
+    # fcqual (obs/quality.py): the one obs module WITH a device half —
+    # the per-round quality bundle rides inside consensus_tail (already
+    # audited above), but its pieces are also independently jittable, so
+    # they get their own entry points: the f64/huge-gather/key-reuse
+    # rules then cover them even if a future caller lifts one out of the
+    # tail.
+    from fastconsensus_tpu.obs import quality as obs_quality
+
+    counts_aval = jnp.ones((cap,), jnp.float32)
+    eps += [
+        EntryPoint("obs.quality.frontier_mask",
+                   mk(lambda s: obs_quality.frontier_mask(s, N_P), slab)),
+        EntryPoint("obs.quality.member_modularity",
+                   mk(obs_quality.member_modularity, slab, labels)),
+        EntryPoint("obs.quality.tail_quality",
+                   mk(lambda al, c, s, lb, pl: obs_quality.tail_quality(
+                       al, c, s, lb, pl, N_P),
+                      slab.alive, counts_aval, slab, labels, labels)),
     ]
     if slab.d_cap > 0:
         adj = da.build_dense_adjacency(slab)
@@ -218,7 +240,12 @@ def entry_points() -> List[EntryPoint]:
     # rate trackers (deliberately jax-free so the report tooling can
     # load them with jax poisoned), pure host arithmetic with zero
     # jittable surface; its histogram/registry fields are lock-guarded,
-    # which the concurrency pass (not the jaxpr audit) verifies.
+    # which the concurrency pass (not the jaxpr audit) verifies.  The
+    # fcqual addition obs/quality.py is the deliberate EXCEPTION to the
+    # obs-is-host-only rule: its device half (the per-round quality
+    # bundle) is registered as entry points above, while its host half
+    # (summarize_history) stays stdlib-only so bench_report can load
+    # history.py with jax poisoned.
     # The fcserve serving layer (serve/) is host-only by the same
     # reasoning: stdlib HTTP/threading/queue/cache machinery whose only
     # device contact is DRIVING run_consensus — already audited above
@@ -350,10 +377,15 @@ def trace_serving_executable(kind: str, n_class: int, e_class: int,
             sds((b,), jnp.bool_), sds((b, 3), jnp.int32))
     if kind == "tail":
         slab = _bucket_slab_struct(n_class, e_class)
+        # prev_labels is a real operand of the served tail executable
+        # since fcqual (consensus.py always passes it), so the modeled
+        # footprint must carry it too
         fn = functools.partial(consensus_tail, n_p=n_p, tau=tau,
                                delta=delta, n_closure=L, sampler="csr")
-        return jax.make_jaxpr(fn)(slab, sds((n_p, n), jnp.int32),
-                                  sds((), key_aval.dtype))
+        return jax.make_jaxpr(
+            lambda s, lb, k, pl: fn(s, lb, k, prev_labels=pl))(
+            slab, sds((n_p, n), jnp.int32), sds((), key_aval.dtype),
+            sds((n_p, n), jnp.int32))
     if kind == "detect":
         slab = _bucket_slab_struct(n_class, e_class)
         return jax.make_jaxpr(

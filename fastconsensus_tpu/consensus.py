@@ -196,8 +196,18 @@ def _resume_from_checkpoint(checkpoint_path: str, slab: GraphSlab,
     # None (mirrors the closure_sampler migration above) and reject a
     # resumed bar — mixing unbarred and barred insert semantics in one
     # run is exactly what this check exists to prevent (ADVICE round 4).
+    ctau_migrated = "closure_tau" not in extra
     extra.setdefault("closure_tau", None)
     if extra["closure_tau"] != config.closure_tau:
+        if ctau_migrated:
+            # be precise about provenance: the None is a checkpoint-
+            # format migration default, not a value read from the file
+            raise ValueError(
+                f"checkpoint {checkpoint_path} predates the closure_tau "
+                f"knob (checkpoint-format migration backfills "
+                f"closure_tau=None: such runs inserted with no bar); "
+                f"resuming with {config.closure_tau} would mix insert "
+                f"semantics")
         raise ValueError(
             f"checkpoint {checkpoint_path} was written with closure_tau="
             f"{extra['closure_tau']}; resuming with "
@@ -749,6 +759,10 @@ def run_consensus(slab: GraphSlab,
         policy.observe the fused block applies in its carry."""
         nonlocal rounds, converged, pstate
         rounds += 1
+        lc = [int(v) for v in np.asarray(stats.labels_changed).ravel()]
+        mod = [float(v) for v in
+               np.asarray(stats.member_modularity).ravel()]
+        n_nodes = max(slab.n_nodes, 1)
         entry = {
             "round": rounds,
             "n_alive": int(stats.n_alive),
@@ -758,8 +772,22 @@ def run_consensus(slab: GraphSlab,
             "n_dropped": int(stats.n_dropped),
             "n_overflow": int(stats.n_overflow),
             "n_hub_overflow": int(stats.n_hub_overflow),
+            "n_agg_overflow": int(stats.n_agg_overflow),
             "cold": bool(stats.cold),
             "capacity": slab.capacity,
+            # fcqual per-round quality series (obs/quality.py docstring
+            # defines each metric; all computed device-side, riding the
+            # same bulk stats readback)
+            "n_w_zero": int(stats.n_w_zero),
+            "n_w_full": int(stats.n_w_full),
+            "n_frontier": int(stats.n_frontier),
+            "frontier_frac": round(int(stats.n_frontier) / n_nodes, 6),
+            "labels_changed": int(sum(lc)),
+            "labels_changed_by_member": lc,
+            "churn_frac": round(sum(lc) / (max(len(lc), 1) * n_nodes), 6),
+            "agreement": round(float(stats.agreement), 6),
+            "modularity_mean": round(sum(mod) / max(len(mod), 1), 6),
+            "modularity_by_member": [round(m, 6) for m in mod],
         }
         history.append(entry)
         obs_counters.fold_round(entry)
@@ -797,9 +825,15 @@ def run_consensus(slab: GraphSlab,
     # same compiled executable as round 0.
     sing_labels = jnp.broadcast_to(
         jnp.arange(slab.n_nodes, dtype=jnp.int32),
-        (config.n_p, slab.n_nodes)) if warm else None
+        (config.n_p, slab.n_nodes))
     if warm and cur_labels is None:
         cur_labels = sing_labels
+    # fcqual churn baseline for the warm_start=False paths, where
+    # cur_labels is not maintained: the labels that entered the current
+    # round (previous round's output; singletons before round 0 — the
+    # same baseline the fused block carries via labels0).  Consumed only
+    # by the quality metrics; never fed back into detection.
+    prev_round_labels = sing_labels
     r = start_round
     while r < end_round:
         t_iter = time.perf_counter()
@@ -807,8 +841,10 @@ def run_consensus(slab: GraphSlab,
         maybe_regrow_budgets()
         pre_slab = slab
         if fused_block > 1:
-            labels0 = cur_labels if warm else jnp.zeros(
-                (config.n_p, slab.n_nodes), jnp.int32)
+            # non-warm blocks carry the singleton baseline as labels0:
+            # detection ignores it (init_labels=None in the block body),
+            # but the carry is the fcqual churn baseline for round 0
+            labels0 = cur_labels if warm else prev_round_labels
             t0 = time.perf_counter()
             noop = budget_noop if budget_noop is not None \
                 else (-1, -1, -1)
@@ -854,6 +890,7 @@ def run_consensus(slab: GraphSlab,
                                 call_s=dt)
             if warm:
                 cur_labels = new_labels
+            prev_round_labels = new_labels
             for i in range(done):
                 if record(jax.tree.map(lambda b: b[i], buf)):
                     break
@@ -916,11 +953,16 @@ def run_consensus(slab: GraphSlab,
                         record_rate(measured_member_s,
                                     cold=not warm or is_cold,
                                     call_s=measured_member_s * members)
+                    # fcqual churn baseline: the labels that entered this
+                    # round — the warm path's cur_labels (even on refresh
+                    # rounds, matching the fused block's carry), the
+                    # non-warm path's tracked previous-round labels
+                    prev_lab = cur_labels if warm else prev_round_labels
                     with tracer.span("tail", r=r):
                         slab, stats = _jitted_tail(
                             config.n_p, config.tau, config.delta,
                             n_closure, mesh, sampler, config.closure_tau)(
-                            slab, labels, k_closure)
+                            slab, labels, k_closure, prev_labels=prev_lab)
                         # fcheck: ok=sync-in-loop (one bulk stats tuple
                         # per round)
                         stats = jax.device_get(stats)
@@ -938,13 +980,14 @@ def run_consensus(slab: GraphSlab,
                         slab, stats = _jitted_tail(
                             config.n_p, config.tau, config.delta,
                             n_closure, mesh, sampler, config.closure_tau)(
-                            slab, labels, k_closure)
+                            slab, labels, k_closure, prev_labels=prev_lab)
                         # fcheck: ok=sync-in-loop (bulk stats of the
                         # replay)
                         stats = jax.device_get(stats)
                         obs_counters.host_sync("round_stats")
                 if warm:
                     cur_labels = labels
+                prev_round_labels = labels
             else:
                 mode = round_mode(r)
                 is_cold = mode != "warm"
@@ -961,14 +1004,17 @@ def run_consensus(slab: GraphSlab,
                         # align passed traced: flipping it mid-run reuses
                         # the same executable (no endgame recompile); cold
                         # refresh rounds take singleton init — round 0's
-                        # executable
+                        # executable.  prev_labels (fcqual churn baseline)
+                        # is always the round's entering labels.
                         slab_new, new_labels, stats = round_fn(
                             slab, k,
                             init_labels=sing_labels if is_cold
                             else cur_labels,
-                            align=jnp.bool_(align_now(r) and not is_cold))
+                            align=jnp.bool_(align_now(r) and not is_cold),
+                            prev_labels=cur_labels)
                     else:
-                        slab_new, new_labels, stats = round_fn(slab, k)
+                        slab_new, new_labels, stats = round_fn(
+                            slab, k, prev_labels=prev_round_labels)
                     slab = slab_new
                     # One bulk device->host transfer for the whole stats
                     # tuple: per-field scalar readbacks each pay the full
@@ -996,6 +1042,7 @@ def run_consensus(slab: GraphSlab,
                                 call_s=dt)
                 if warm:
                     cur_labels = new_labels
+                prev_round_labels = new_labels
             r += 1
             stats = stats._replace(cold=np.bool_(is_cold))
             record(stats)
@@ -1157,7 +1204,10 @@ def run_consensus_batch(slabs,
 
     sing = jnp.broadcast_to(jnp.arange(n_nodes, dtype=jnp.int32),
                             (B, n_p, n_nodes))
-    labels = sing if warm else jnp.zeros((B, n_p, n_nodes), jnp.int32)
+    # non-warm carries the singleton baseline too: detection ignores it
+    # (scratch mode passes init=None), but the labels carry is the fcqual
+    # churn baseline for round 0 — solo-driver parity (prev_round_labels)
+    labels = sing
 
     histories: List[List[dict]] = [[] for _ in range(B)]
     pstates = [policy.state_from_history([]) for _ in range(B)]
@@ -1211,6 +1261,12 @@ def run_consensus_batch(slabs,
                     # the solo driver would grow-and-replay this round
                     split_off(i, f"slab saturated at round {rounds[i]}")
                     break
+                # fcheck: ok=sync-in-loop (pure host-side numpy — buf was
+                # bulk-device_get'd once above; these just reshape rows)
+                lc = [int(v) for v in np.asarray(st.labels_changed).ravel()]
+                mod = [float(v) for v in
+                       # fcheck: ok=sync-in-loop (same host-side buf)
+                       np.asarray(st.member_modularity).ravel()]
                 entry = {
                     "round": int(rounds[i]) + 1,
                     "n_alive": int(st.n_alive),
@@ -1220,8 +1276,23 @@ def run_consensus_batch(slabs,
                     "n_dropped": int(st.n_dropped),
                     "n_overflow": int(st.n_overflow),
                     "n_hub_overflow": int(st.n_hub_overflow),
+                    "n_agg_overflow": int(st.n_agg_overflow),
                     "cold": bool(st.cold),
                     "capacity": base.capacity,
+                    # fcqual series — key-for-key with the solo record()
+                    "n_w_zero": int(st.n_w_zero),
+                    "n_w_full": int(st.n_w_full),
+                    "n_frontier": int(st.n_frontier),
+                    "frontier_frac": round(
+                        int(st.n_frontier) / max(n_nodes, 1), 6),
+                    "labels_changed": int(sum(lc)),
+                    "labels_changed_by_member": lc,
+                    "churn_frac": round(
+                        sum(lc) / (max(len(lc), 1) * max(n_nodes, 1)), 6),
+                    "agreement": round(float(st.agreement), 6),
+                    "modularity_mean": round(
+                        sum(mod) / max(len(mod), 1), 6),
+                    "modularity_by_member": [round(m, 6) for m in mod],
                 }
                 histories[i].append(entry)
                 pstates[i] = policy.observe(

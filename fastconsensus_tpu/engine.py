@@ -35,6 +35,7 @@ from fastconsensus_tpu import policy
 from fastconsensus_tpu.graph import GraphSlab
 from fastconsensus_tpu.models.base import Detector
 from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs import quality as obs_quality
 from fastconsensus_tpu.obs.tracer import get_tracer
 from fastconsensus_tpu.ops import consensus_ops as cops
 from fastconsensus_tpu.utils import prng
@@ -54,10 +55,26 @@ class RoundStats(NamedTuple):
     n_hub_overflow: jax.Array  # int32[] hub directed edges beyond hub_cap,
                                # i.e. dropped from the hybrid path's hashed
                                # move candidates (ops/dense_adj.build_hybrid)
+    n_agg_overflow: jax.Array  # int32[] upper bound on alive aggregate
+                               # edges graph.compact_alive will silently
+                               # drop next round (0 when the aggregate
+                               # compaction is provably lossless or off;
+                               # see graph.agg_compaction_active)
     cold: jax.Array            # bool[] this round ran full-sweep singleton
                                # -start detection (round 0 / cold mode /
                                # stagnation refresh); drives the stall
                                # reset and is recorded in history
+    # --- fcqual quality bundle (obs/quality.py) -------------------------
+    n_w_zero: jax.Array        # int32[] alive edges at weight 0
+    n_w_full: jax.Array        # int32[] alive edges at weight >= n_p
+    n_frontier: jax.Array      # int32[] vertices on >= 1 mid-band edge —
+                               # the active-frontier estimate
+    labels_changed: jax.Array  # int32[n_p] per-member label churn vs the
+                               # previous round's labels
+    member_modularity: jax.Array  # float32[n_p] per-member Newman Q on
+                               # the end-of-round weighted slab
+    agreement: jax.Array       # float32[] mean pairwise co-membership
+                               # agreement over round-start alive edges
 
 
 def consensus_tail(slab: GraphSlab,
@@ -68,7 +85,8 @@ def consensus_tail(slab: GraphSlab,
                    delta: float,
                    n_closure: int,
                    sampler: str = "scatter",
-                   closure_tau: Optional[float] = None
+                   closure_tau: Optional[float] = None,
+                   prev_labels: Optional[jax.Array] = None
                    ) -> Tuple[GraphSlab, RoundStats]:
     """Everything after detection: co-membership -> threshold -> convergence
     -> closure -> repair.  Jittable; shared by the one-call
@@ -77,6 +95,11 @@ def consensus_tail(slab: GraphSlab,
     ``sampler`` selects the wedge-sampling lowering (static; see
     ConsensusConfig.closure_sampler): "csr" is the single-chip fast path,
     "scatter" the edge-local engine the shard_map tail shares bit-exactly.
+
+    ``prev_labels`` ([n_p, N]) is the previous round's labels, consumed
+    only by the fcqual churn metric (obs/quality.py); None (round 0 /
+    legacy callers) measures churn against the singleton baseline.  It
+    never influences the slab or control flow — results are invariant.
     """
     counts = cops.comembership_counts(labels, slab.src, slab.dst)
     prev = slab  # round-start weights; used by singleton repair (fc:194)
@@ -135,6 +158,16 @@ def consensus_tail(slab: GraphSlab,
         n_hub_overflow = jnp.maximum(hub_mass - slab.hub_cap, 0)
     else:
         n_hub_overflow = jnp.int32(0)
+    from fastconsensus_tpu.graph import agg_compaction_active
+    if agg_compaction_active(slab):
+        # upper bound on alive aggregate edges compact_alive will rank
+        # past agg_cap next round (distinct aggregate pairs <= alive
+        # consensus edges, so 0 here means provably lossless)
+        n_agg_overflow = jnp.maximum(st_end.n_alive - slab.agg_cap, 0)
+    else:
+        n_agg_overflow = jnp.int32(0)
+    qual = obs_quality.tail_quality(prev.alive, counts, slab, labels,
+                                    prev_labels, n_p)
     stats = RoundStats(
         converged=st_mid.converged | st_end.converged,
         n_alive=st_end.n_alive,
@@ -144,7 +177,14 @@ def consensus_tail(slab: GraphSlab,
         n_dropped=n_dropped,
         n_overflow=n_overflow,
         n_hub_overflow=n_hub_overflow,
+        n_agg_overflow=n_agg_overflow,
         cold=jnp.bool_(False),  # the caller (driver / block body) knows
+        n_w_zero=qual.n_w_zero,
+        n_w_full=qual.n_w_full,
+        n_frontier=qual.n_frontier,
+        labels_changed=qual.labels_changed,
+        member_modularity=qual.member_modularity,
+        agreement=qual.agreement,
     )
     return slab, stats
 
@@ -175,7 +215,8 @@ def consensus_round(slab: GraphSlab,
                     init_labels: Optional[jax.Array] = None,
                     align: bool = False,
                     sampler: str = "scatter",
-                    closure_tau: Optional[float] = None
+                    closure_tau: Optional[float] = None,
+                    prev_labels: Optional[jax.Array] = None
                     ) -> Tuple[GraphSlab, jax.Array, RoundStats]:
     """One full consensus round.  Jittable; all shapes static.
 
@@ -233,11 +274,13 @@ def consensus_round(slab: GraphSlab,
 
         slab, stats = stail.sharded_consensus_tail(
             slab, labels, k_closure, n_p, tau, delta, n_closure,
-            ensemble_sharding.mesh, closure_tau=closure_tau)
+            ensemble_sharding.mesh, closure_tau=closure_tau,
+            prev_labels=prev_labels)
     else:
         slab, stats = consensus_tail(slab, labels, k_closure, n_p, tau,
                                      delta, n_closure, sampler=sampler,
-                                     closure_tau=closure_tau)
+                                     closure_tau=closure_tau,
+                                     prev_labels=prev_labels)
     return slab, labels, stats
 
 
@@ -335,10 +378,16 @@ def consensus_rounds_block(slab: GraphSlab,
     """
     def empty_stats():
         z = jnp.zeros((block,), jnp.int32)
+        zp = jnp.zeros((block, n_p), jnp.int32)
         return RoundStats(converged=jnp.zeros((block,), bool), n_alive=z,
                           n_unconverged=z, n_closure_added=z, n_repaired=z,
                           n_dropped=z, n_overflow=z, n_hub_overflow=z,
-                          cold=jnp.zeros((block,), bool))
+                          n_agg_overflow=z,
+                          cold=jnp.zeros((block,), bool),
+                          n_w_zero=z, n_w_full=z, n_frontier=z,
+                          labels_changed=zp,
+                          member_modularity=zp.astype(jnp.float32),
+                          agreement=jnp.zeros((block,), jnp.float32))
 
     def cond(carry):
         _, i, conv, _, _, _, _, need = carry
@@ -368,7 +417,7 @@ def consensus_rounds_block(slab: GraphSlab,
                         s, kk, detect=d, n_p=n_p, tau=tau, delta=delta,
                         n_closure=n_closure, init_labels=sing,
                         align=False, sampler=sampler,
-                        closure_tau=closure_tau)
+                        closure_tau=closure_tau, prev_labels=lab)
                 return go
 
             def run_cold(op):
@@ -386,16 +435,19 @@ def consensus_rounds_block(slab: GraphSlab,
                 return consensus_round(
                     s, kk, detect=detect_warm, n_p=n_p, tau=tau,
                     delta=delta, n_closure=n_closure, init_labels=lab,
-                    align=al, sampler=sampler, closure_tau=closure_tau)
+                    align=al, sampler=sampler, closure_tau=closure_tau,
+                    prev_labels=lab)
 
             slab, labels, st = jax.lax.cond(
                 cold, run_cold, run_warm, (slab, k, labels, aligned))
             st = st._replace(cold=cold)
         else:
+            prev_lab = labels
             slab, labels, st = consensus_round(
                 slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
                 n_closure=n_closure, init_labels=None, align=False,
-                sampler=sampler, closure_tau=closure_tau)
+                sampler=sampler, closure_tau=closure_tau,
+                prev_labels=prev_lab)
             st = st._replace(cold=jnp.bool_(True))
         # fold the round into the carried stagnation state — the same
         # policy.observe the host's record() applies, so fused and
@@ -497,10 +549,16 @@ def consensus_batch_block(slab: GraphSlab,
 
     def empty_stats():
         z = jnp.zeros((block,), jnp.int32)
+        zp = jnp.zeros((block, n_p), jnp.int32)
         return RoundStats(converged=jnp.zeros((block,), bool), n_alive=z,
                           n_unconverged=z, n_closure_added=z, n_repaired=z,
                           n_dropped=z, n_overflow=z, n_hub_overflow=z,
-                          cold=jnp.zeros((block,), bool))
+                          n_agg_overflow=z,
+                          cold=jnp.zeros((block,), bool),
+                          n_w_zero=z, n_w_full=z, n_frontier=z,
+                          labels_changed=zp,
+                          member_modularity=zp.astype(jnp.float32),
+                          agreement=jnp.zeros((block,), jnp.float32))
 
     def cond(carry):
         _, i, conv, _, _, aligned, pst, need = carry
@@ -516,11 +574,13 @@ def consensus_batch_block(slab: GraphSlab,
     def body(carry):
         slab, i, _, buf, labels, aligned, pst, _ = carry
         k = prng.stream(key, prng.STREAM_ROUND, start_round + i)
+        prev_lab = labels
         if mode == "warm":
             slab, labels, st = consensus_round(
                 slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
                 n_closure=n_closure, init_labels=labels, align=aligned,
-                sampler=sampler, closure_tau=closure_tau)
+                sampler=sampler, closure_tau=closure_tau,
+                prev_labels=prev_lab)
             st = st._replace(cold=jnp.bool_(False))
         else:
             init = None
@@ -531,7 +591,8 @@ def consensus_batch_block(slab: GraphSlab,
             slab, labels, st = consensus_round(
                 slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
                 n_closure=n_closure, init_labels=init, align=False,
-                sampler=sampler, closure_tau=closure_tau)
+                sampler=sampler, closure_tau=closure_tau,
+                prev_labels=prev_lab)
             st = st._replace(cold=jnp.bool_(True))
         pst = policy.observe(jnp, pst, st.cold, st.n_unconverged,
                              st.n_alive)

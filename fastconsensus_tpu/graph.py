@@ -221,6 +221,19 @@ def derive_agg_sizing(n_alive: int) -> int:
     return ((want + 4095) // 4096) * 4096
 
 
+def agg_compaction_active(slab: GraphSlab) -> bool:
+    """Static gate: will the aggregate level run :func:`compact_alive`?
+
+    Single source of truth shared by models/leiden.py (which compacts
+    under exactly this condition) and the engine's per-round
+    ``n_agg_overflow`` accounting (RoundStats), which bounds how many
+    alive aggregate edges the compaction could silently drop.  Gated on
+    the pack-time ``cap_hint``, not live capacity — the growth-stability
+    contract (labels must not change when auto-growth resizes the slab).
+    """
+    return 0 < slab.agg_cap < (slab.cap_hint or slab.capacity)
+
+
 def compact_alive(slab: GraphSlab, cap: int) -> GraphSlab:
     """Pack the alive edges into a fresh slab of static capacity ``cap``.
 
@@ -235,7 +248,10 @@ def compact_alive(slab: GraphSlab, cap: int) -> GraphSlab:
     rounds: the driver refreshes agg_cap for free whenever any dense/hub
     budget regrows, but the standalone agg trigger is deliberately loose
     (25% past budget — policy.budgets_stale) so agg staleness alone
-    rarely costs a recompile.
+    rarely costs a recompile.  The stale window is no longer silent:
+    every round reports ``n_agg_overflow`` (an upper bound on the drop,
+    0 = provably lossless) in RoundStats / ``rounds.jsonl`` — see
+    :func:`agg_compaction_active`.
 
     The result carries no dense/hybrid sizing (aggregate supernode degrees
     are unbounded) and ``cap_hint = cap`` so hash-bucket sizing tracks the

@@ -89,7 +89,11 @@ def leiden_single(slab: GraphSlab, key: jax.Array,
     # --capacity) resizes the slab mid-run (the louvain._cap_hint
     # contract; round-5 review).  Late-run agg_cap may exceed live
     # capacity by its 12.5% slack — a bounded waste, never a loss.
-    if 0 < slab.agg_cap < (slab.cap_hint or slab.capacity):
+    # The gate is shared with the engine's n_agg_overflow accounting
+    # (graph.agg_compaction_active), which surfaces any drop per round.
+    from fastconsensus_tpu.graph import agg_compaction_active, compact_alive
+
+    if agg_compaction_active(slab):
         # Compacted aggregate move: the hash path's per-sweep cost is
         # linear in the scanned capacity, and the aggregate uses only
         # ~the alive fraction of the consensus slab's slots (27.4 ->
@@ -97,8 +101,6 @@ def leiden_single(slab: GraphSlab, key: jax.Array,
         # agg_cap >= the alive count at sizing time makes this lossless
         # (distinct aggregate pairs <= alive edges); the driver re-derives
         # agg_cap with the other budgets as closure densifies the slab.
-        from fastconsensus_tpu.graph import compact_alive
-
         agg = compact_alive(agg, slab.agg_cap)
     group_comm = jax.ops.segment_max(
         comm, jnp.clip(refined, 0, n - 1), num_segments=n)

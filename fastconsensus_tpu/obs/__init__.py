@@ -32,6 +32,17 @@ Grown in PR 3 from a host tracer into the full stack:
   plus per-bucket arrival/dispatch rate tracking — the request-
   lifecycle layer behind ``/metricsz``'s ``latency`` block and the
   ``bench.py serve_load`` latency-vs-RPS regression gate.
+* **obs/quality.py** — fcqual: consensus-convergence & partition-
+  quality metrics.  The device half (weight-band counts, active
+  frontier, per-member label churn, ensemble agreement, per-member
+  modularity) is jitted INTO the round executables and rides the
+  existing once-per-round stats readback — the one deliberate
+  exception to the obs-is-host-only rule, so it imports jax and is
+  NOT imported here (import it directly:
+  ``from fastconsensus_tpu.obs import quality``).  The host half
+  (``summarize_history``) folds the per-round series into the
+  run-level ``telemetry.quality`` block that ``obs/history.py``'s
+  ``check_quality`` gates in CI.
 
 Continuity: counter snapshots persist in checkpoint metadata
 (utils/checkpoint.py) and delta-restore on resume

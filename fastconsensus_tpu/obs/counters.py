@@ -216,7 +216,10 @@ def host_sync(tag: str, n: int = 1) -> None:
 def fold_round(entry: dict) -> None:
     """Fold one round's history entry (consensus.run_consensus.record)
     into the registry: round counts, closure/repair/drop totals, the
-    converged-edge fraction series, and the slab-capacity gauge."""
+    converged-edge fraction series, the slab-capacity gauge, and the
+    fcqual ``consensus.quality.*`` series (obs/quality.py).  The quality
+    keys are optional — pre-fcqual entries (resumed legacy checkpoints)
+    fold without them."""
     _REGISTRY.inc("rounds.total")
     if entry.get("cold"):
         _REGISTRY.inc("rounds.cold")
@@ -229,6 +232,17 @@ def fold_round(entry: dict) -> None:
         _REGISTRY.observe("round.converged_frac", frac)
     if entry.get("capacity"):
         _REGISTRY.gauge("slab.capacity", entry["capacity"])
+    # fcqual: per-round quality series + cumulative counters (the
+    # counters persist in checkpoints and delta-restore on resume, like
+    # every other counter in the registry)
+    for key in ("agreement", "frontier_frac", "churn_frac",
+                "modularity_mean"):
+        if entry.get(key) is not None:
+            _REGISTRY.observe(f"consensus.quality.{key}", float(entry[key]))
+    _REGISTRY.inc("quality.labels_changed_total",
+                  entry.get("labels_changed", 0))
+    _REGISTRY.inc("quality.agg_overflow_total",
+                  entry.get("n_agg_overflow", 0))
 
 
 def device_memory() -> Optional[dict]:

@@ -37,6 +37,18 @@ latency at the curve's reference RPS — these artifacts are
 lower-is-better, so :func:`check_history` deliberately skips its
 throughput/NMI rules for them (the warm-compile rule still applies).
 
+The fcqual quality block (``telemetry.quality`` — obs/quality.py's
+:func:`~fastconsensus_tpu.obs.quality.summarize_history` output, stamped
+by ``bench.py`` on every run artifact) rides the same reader: records
+keep the block verbatim (``quality`` in the normalized record),
+:func:`quality_table` renders the convergence-quality trend (rounds to
+converge, final ensemble agreement / modularity, the late-round
+active-frontier fraction) and :func:`check_quality` gates it — a
+rounds-to-converge blow-up, a final-agreement drop, or a late-frontier
+fraction that stops shrinking is a *partition-quality* regression the
+throughput gate cannot see (a kernel bug that scrambles labels can
+leave partitions/s untouched).
+
 The fcheck-footprint artifacts (``runs/footprint_rNN.json``, written by
 ``python -m fastconsensus_tpu.analysis --footprint-out``) ride the same
 reader: :func:`load_footprints` / :func:`footprint_table` render the
@@ -74,6 +86,17 @@ DEFAULT_NMI_DROP = 0.05
 DEFAULT_P95_GROWTH_FRAC = 1.0     # p95 at the reference RPS may double
 DEFAULT_SLO_DROP = 0.15           # absolute attainment drop at ref RPS
 DEFAULT_R429_GROWTH = 0.20        # absolute 429-rate growth at ref RPS
+
+# fcqual (quality-block) gate thresholds.  Same calibration philosophy:
+# loose enough that detector stochasticity (seeded, but the LFR graphs
+# themselves differ per generator build) never trips them, tight enough
+# that the failure modes they exist for — a weight-update bug doubling
+# rounds-to-converge, a churn bug collapsing ensemble agreement, a
+# frontier that stops contracting because thresholding went dead — all
+# land well outside the band.
+DEFAULT_ROUNDS_GROWTH_FRAC = 1.0  # rounds-to-converge may double
+DEFAULT_AGREEMENT_DROP = 0.10     # absolute final-agreement drop
+DEFAULT_FRONTIER_GROWTH = 0.25    # absolute late-frontier-frac growth
 
 
 def _seq_from_name(path: str) -> Optional[int]:
@@ -143,6 +166,10 @@ def _normalize(rec: dict, source: str, seq: Optional[int]) -> dict:
         # per-RPS latency curve, kept verbatim for serve_load_table()
         # and check_serve_load()
         "serve_load": tel.get("serve_load") or None,
+        # fcqual quality block (obs/quality.py summarize_history), kept
+        # verbatim for quality_table() and check_quality(); None on
+        # pre-fcqual artifacts
+        "quality": tel.get("quality") or None,
     }
 
 
@@ -435,6 +462,120 @@ def check_serve_load(groups: Dict[str, List[dict]],
                         f"RPS ({ref}) grew more than {r429_growth} "
                         f"over the prior median {base:.3f} — the "
                         f"server sheds load it used to serve")
+    return problems
+
+
+_Q_COLUMNS: List[Tuple[str, str]] = [
+    ("rounds", "rounds"), ("rounds_to_converge", "rtc"),
+    ("final_agreement", "agreement"),
+    ("final_modularity_mean", "modularity"),
+    ("final_frontier_frac", "frontier"),
+    ("late_frontier_frac", "late_frontier"),
+    ("final_churn_frac", "churn"),
+    ("labels_changed_total", "labels_moved"),
+    ("agg_overflow_total", "agg_ovfl"),
+]
+
+
+def quality_table(groups: Dict[str, List[dict]],
+                  markdown: bool = False) -> str:
+    """Convergence-quality trend tables for configs whose records carry
+    the fcqual ``quality`` block: per artifact, rounds run / rounds to
+    converge (``-`` = hit max_rounds unconverged), final ensemble
+    agreement and mean modularity, the final and late-half active-
+    frontier fractions (how much of the graph still has undecided
+    consensus edges — the number a frontier-masked detect pass would
+    exploit), total label churn, and aggregate-overflow total.  Empty
+    string when no record in the history has a quality block."""
+    lines: List[str] = []
+    for config, recs in groups.items():
+        rows = [[_fmt(r["seq"]), r["source"]]
+                + [_fmt((r["quality"] or {}).get(k)) for k, _ in
+                   _Q_COLUMNS]
+                for r in recs if r.get("quality")]
+        if not rows:
+            continue
+        lines += _render_rows(f"{config} quality",
+                              ["seq", "source"]
+                              + [h for _, h in _Q_COLUMNS],
+                              rows, markdown)
+    return "\n".join(lines).rstrip()
+
+
+def check_quality(groups: Dict[str, List[dict]],
+                  rounds_growth_frac: float = DEFAULT_ROUNDS_GROWTH_FRAC,
+                  agreement_drop: float = DEFAULT_AGREEMENT_DROP,
+                  frontier_growth: float = DEFAULT_FRONTIER_GROWTH
+                  ) -> List[str]:
+    """Partition-quality regression findings over the fcqual blocks; []
+    means the gate passes.  Per config, the newest sequenced record
+    carrying a quality block is judged against the median of its
+    sequenced predecessors (same arming rule as every other gate here:
+    fewer than two sequenced quality-carrying records = no trajectory =
+    pass):
+
+    * **rounds-to-converge growth** — the run converges, but in more
+      than ``(1 + rounds_growth_frac) x`` the prior median rounds: the
+      consensus loop is spinning (a weight-update or churn bug that
+      throughput alone hides, because later rounds are cheaper);
+    * **final-agreement drop** — final ensemble agreement fell more
+      than ``agreement_drop`` (absolute) below the prior median: the
+      ensemble stopped agreeing on the partition it ships;
+    * **late-frontier growth** — the late-half mean active-frontier
+      fraction grew more than ``frontier_growth`` (absolute) over the
+      prior median: the frontier stopped contracting, i.e. weight
+      thresholding/freezing went dead and "converged" is no longer
+      doing work.
+    """
+    problems: List[str] = []
+    for config, recs in groups.items():
+        seqd = [r for r in recs if r["seq"] is not None
+                and r.get("quality")]
+        if len(seqd) < 2:
+            continue
+        latest_seq = max(r["seq"] for r in seqd)
+        latest = [r for r in seqd if r["seq"] == latest_seq]
+        prior = [r["quality"] for r in seqd if r["seq"] < latest_seq]
+
+        def _prior(key):
+            vals = [q.get(key) for q in prior]
+            return [v for v in vals if v is not None]
+
+        prior_rtc = _prior("rounds_to_converge")
+        prior_agree = _prior("final_agreement")
+        prior_frontier = _prior("late_frontier_frac")
+        for r in latest:
+            q = r["quality"]
+            tag = f"{config} [{r['source']} seq {r['seq']}]"
+            rtc = q.get("rounds_to_converge")
+            if prior_rtc and rtc is not None:
+                base = _median(prior_rtc)
+                ceil = (1.0 + rounds_growth_frac) * base
+                if rtc > ceil:
+                    problems.append(
+                        f"{tag}: rounds-to-converge {rtc} grew past "
+                        f"{ceil:.1f} ({rounds_growth_frac:.0%} over the "
+                        f"prior median {base:.1f}) — the consensus loop "
+                        f"is spinning (quality.rounds_to_converge)")
+            agree = q.get("final_agreement")
+            if prior_agree and agree is not None:
+                base = _median(prior_agree)
+                if agree < base - agreement_drop:
+                    problems.append(
+                        f"{tag}: final ensemble agreement {agree:.3f} "
+                        f"dropped more than {agreement_drop} below the "
+                        f"prior median {base:.3f} "
+                        f"(quality.final_agreement)")
+            frontier = q.get("late_frontier_frac")
+            if prior_frontier and frontier is not None:
+                base = _median(prior_frontier)
+                if frontier > base + frontier_growth:
+                    problems.append(
+                        f"{tag}: late-round active-frontier fraction "
+                        f"{frontier:.3f} grew more than "
+                        f"{frontier_growth} over the prior median "
+                        f"{base:.3f} — the frontier stopped "
+                        f"contracting (quality.late_frontier_frac)")
     return problems
 
 

@@ -1,0 +1,257 @@
+"""fcqual: consensus-convergence and partition-quality metrics.
+
+Two halves, one file:
+
+* **Device half** (jax): pure jittable functions over the static-shape
+  GraphSlab that the consensus tails (``engine.consensus_tail``,
+  ``ops.sharded_tail._tail_local``) fold into :class:`RoundStats` each
+  round.  Everything here rides the existing once-per-round stats
+  readback — the functions return device scalars/vectors that travel in
+  the same bulk ``device_get`` as the rest of RoundStats, so
+  instrumentation adds **zero new host syncs** (pinned by
+  tests/test_quality.py against ``obs.counters.host_sync``).
+
+* **Host half** (stdlib): :func:`summarize_history` compresses a run's
+  per-round history entries into the ``quality`` telemetry block that
+  bench.py embeds in its BENCH line and fcserve attaches to cached
+  results (``/result`` / ``/status``).  The regression *gate* over those
+  blocks lives in ``obs/history.py`` (``check_quality``) because the
+  gate must run on jax-free boxes; this module imports jax at top level
+  and is deliberately NOT re-exported from ``obs/__init__``.
+
+Metric definitions (README "Quality observability: fcqual"):
+
+weight histogram
+    End-of-round alive consensus edges split into the three bands the
+    convergence criterion is built from (ops.consensus_ops
+    .convergence_stats): weight 0 (closure inserts no partition agreed
+    on), weight >= n_p (unanimous, frozen by update_weights), and the
+    mid band 0 < w < n_p (already reported as ``n_unconverged``).  The
+    histogram turns the one-scalar criterion into a distribution.
+
+label churn
+    Per ensemble member, the count of vertices whose community id
+    differs from the member's previous-round labels.  Raw label
+    disagreement — a pure relabeling counts, so this is an upper bound
+    on real partition movement; warm-started members keep ids stable in
+    practice, which is exactly the regime incremental consensus cares
+    about.  Round 0 is measured against the singleton baseline
+    (= the warm-start detection init).
+
+ensemble agreement
+    Mean pairwise co-membership agreement over the round-start alive
+    edges: for an edge with co-membership count c (of n_p members),
+    the fraction of member pairs that agree on whether its endpoints
+    share a community is (c*(c-1) + (n_p-c)*(n_p-c-1)) / (n_p*(n_p-1)).
+    Computed from the per-edge ``counts`` the tail already materializes
+    for update_weights — no extra major compute.
+
+member modularity
+    Newman modularity of each member's partition on the end-of-round
+    *weighted* consensus slab: Q_m = intra_m/W - sum_c (D_c/(2W))^2
+    with W the total alive weight, intra_m the alive weight inside m's
+    communities, D_c the weighted-degree mass of community c.
+
+active frontier
+    Count of vertices incident to >= 1 mid-band edge at round end — the
+    exact population a ``where``-masked move phase would process, i.e.
+    the measured basis for the ROADMAP's pruned vertex-parallel
+    refinement item (FastEnsemble, arXiv:2409.02077; Louvain pruning,
+    arXiv:1503.01322).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from fastconsensus_tpu.graph import GraphSlab
+
+
+class QualityStats(NamedTuple):
+    """Device-side per-round quality bundle (one field group of RoundStats).
+
+    Scalars unless noted; the two ``[n_p]`` vectors widen the RoundStats
+    block buffers to ``[block, n_p]`` — the ``jax.tree.map`` fold in
+    engine.consensus_rounds_block handles that shape generically.
+    """
+
+    n_w_zero: jax.Array           # int32[]  alive edges at weight 0
+    n_w_full: jax.Array           # int32[]  alive edges at weight >= n_p
+    n_frontier: jax.Array         # int32[]  vertices on >= 1 mid-band edge
+    labels_changed: jax.Array     # int32[n_p]  per-member label churn
+    member_modularity: jax.Array  # float32[n_p]
+    agreement: jax.Array          # float32[]  mean pairwise agreement
+
+
+def singleton_labels(n_p: int, n_nodes: int) -> jax.Array:
+    """The round-0 churn baseline: every vertex its own community.
+
+    Identical to the warm-start detection init, so round-0 churn reads
+    "vertices the first detection moved off the singleton start".
+    """
+    return jnp.broadcast_to(
+        jnp.arange(n_nodes, dtype=jnp.int32), (n_p, n_nodes))
+
+
+def weight_band_counts(slab: GraphSlab, n_p: int
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """(n_w_zero, n_w_full): alive edges at the histogram's two poles.
+
+    The mid band is RoundStats.n_unconverged (same mask as
+    convergence_stats); zero/full/mid partition the alive edges.
+    """
+    alive = slab.alive
+    n_zero = jnp.sum((alive & (slab.weight <= 0.0)).astype(jnp.int32))
+    n_full = jnp.sum(
+        (alive & (slab.weight >= jnp.float32(n_p))).astype(jnp.int32))
+    return n_zero, n_full
+
+
+def frontier_mask(slab: GraphSlab, n_p: int) -> jax.Array:
+    """bool[n_nodes]: vertices incident to >= 1 alive mid-band edge.
+
+    This is the population a where-masked move phase would process; dead
+    slots scatter to a sacrificial row so the mask is exact under the
+    static-capacity slab.
+    """
+    n = slab.n_nodes
+    mid = slab.alive & (slab.weight > 0) & \
+        (slab.weight < jnp.float32(n_p))
+    one = mid.astype(jnp.int32)
+    hits = jnp.zeros((n + 1,), jnp.int32)
+    hits = hits.at[jnp.where(mid, slab.src, n)].add(one, mode="drop")
+    hits = hits.at[jnp.where(mid, slab.dst, n)].add(one, mode="drop")
+    return hits[:n] > 0
+
+
+def active_frontier(slab: GraphSlab, n_p: int) -> jax.Array:
+    """int32[]: size of the active frontier (see frontier_mask)."""
+    return jnp.sum(frontier_mask(slab, n_p).astype(jnp.int32))
+
+
+def label_churn(labels: jax.Array, prev_labels: jax.Array) -> jax.Array:
+    """int32[n_p]: per-member count of vertices whose label changed."""
+    return jnp.sum((labels != prev_labels).astype(jnp.int32), axis=1)
+
+
+def edge_agreement(counts: jax.Array, alive: jax.Array, n_p: int
+                   ) -> jax.Array:
+    """float32[]: mean pairwise co-membership agreement over alive edges.
+
+    ``counts`` is the float32[E] per-edge co-membership count the tail
+    computes for update_weights; ``alive`` is the round-start mask the
+    counts were taken over.  n_p == 1 has no member pairs: defined as 1.
+    """
+    if n_p <= 1:
+        return jnp.float32(1.0)
+    c = counts
+    f = jnp.float32(n_p)
+    pair_agree = c * (c - 1.0) + (f - c) * (f - c - 1.0)
+    tot = jnp.sum(jnp.where(alive, pair_agree, 0.0))
+    n_alive = jnp.sum(alive.astype(jnp.int32)).astype(jnp.float32)
+    denom = jnp.maximum(n_alive, 1.0) * f * (f - 1.0)
+    return tot / denom
+
+
+def member_modularity(slab: GraphSlab, labels: jax.Array) -> jax.Array:
+    """float32[n_p]: Newman modularity of each member on the weighted slab.
+
+    Uses the end-of-round consensus weights (alive edges only):
+    Q_m = intra_m / W - sum_c (D_c / (2W))^2.  An empty slab (W == 0)
+    reports 0 for every member.
+    """
+    n = slab.n_nodes
+    w = jnp.where(slab.alive, slab.weight, 0.0)
+    total_w = jnp.sum(w)
+    w_safe = jnp.maximum(total_w, jnp.float32(1e-30))
+    deg = slab.strengths()  # float32[n] weighted degree, alive edges
+
+    def one(lab: jax.Array) -> jax.Array:
+        intra = jnp.sum(
+            jnp.where(lab[slab.src] == lab[slab.dst], w, 0.0))
+        # community degree mass: labels are vertex ids in [0, n)
+        d_c = jnp.zeros((n,), jnp.float32).at[lab].add(deg)
+        return intra / w_safe - jnp.sum((d_c / (2.0 * w_safe)) ** 2)
+
+    q = jax.vmap(one)(labels)
+    return jnp.where(total_w > 0.0, q, jnp.zeros_like(q))
+
+
+def tail_quality(start_alive: jax.Array,
+                 counts: jax.Array,
+                 slab: GraphSlab,
+                 labels: jax.Array,
+                 prev_labels: Optional[jax.Array],
+                 n_p: int) -> QualityStats:
+    """Assemble the per-round quality bundle inside a consensus tail.
+
+    ``start_alive``/``counts`` are the round-start alive mask and the
+    co-membership counts taken over it (agreement's population);
+    ``slab`` is the end-of-round slab (histogram / frontier /
+    modularity population); ``prev_labels`` None means round 0 — churn
+    falls back to the singleton baseline.
+    """
+    if prev_labels is None:
+        prev_labels = singleton_labels(n_p, slab.n_nodes)
+    n_zero, n_full = weight_band_counts(slab, n_p)
+    return QualityStats(
+        n_w_zero=n_zero,
+        n_w_full=n_full,
+        n_frontier=active_frontier(slab, n_p),
+        labels_changed=label_churn(labels, prev_labels),
+        member_modularity=member_modularity(slab, labels),
+        agreement=jnp.float32(edge_agreement(counts, start_alive, n_p)),
+    )
+
+
+# --------------------------------------------------------------------------
+# Host half: run-level summary for telemetry blocks (bench.py, fcserve).
+# Pure stdlib over already-fetched history dicts — no device access.
+# --------------------------------------------------------------------------
+
+#: history-entry keys written by consensus.record()/record_block() that
+#: carry the per-round quality series (missing on pre-fcqual histories).
+ENTRY_KEYS = ("n_w_zero", "n_w_full", "n_frontier", "frontier_frac",
+              "labels_changed", "churn_frac", "agreement",
+              "modularity_mean", "n_agg_overflow")
+
+
+def summarize_history(history: List[Dict[str, Any]],
+                      converged: Optional[bool] = None
+                      ) -> Optional[Dict[str, Any]]:
+    """Compress a run's per-round history into the ``quality`` block.
+
+    Returns None when the history carries no quality series (pre-fcqual
+    checkpoints, empty runs) so callers can omit the block instead of
+    emitting a husk.  ``converged`` is the run's final convergence flag;
+    ``rounds_to_converge`` is reported only when the run converged.
+    """
+    qrounds = [h for h in history if h.get("agreement") is not None]
+    if not qrounds:
+        return None
+    last = qrounds[-1]
+    frontier = [float(h.get("frontier_frac", 0.0)) for h in qrounds]
+    # "late" = the second half of the trajectory, where a frontier mask
+    # would actually prune work (round 0 is always ~the whole graph)
+    late = frontier[len(frontier) // 2:]
+    block: Dict[str, Any] = {
+        "rounds": len(history),
+        "final_agreement": float(last["agreement"]),
+        "final_modularity_mean": float(last.get("modularity_mean", 0.0)),
+        "final_frontier_frac": float(last.get("frontier_frac", 0.0)),
+        "final_churn_frac": float(last.get("churn_frac", 0.0)),
+        "late_frontier_frac": sum(late) / max(len(late), 1),
+        "frontier_frac_by_round": frontier,
+        "agreement_by_round": [float(h["agreement"]) for h in qrounds],
+        "labels_changed_total": int(sum(
+            int(h.get("labels_changed", 0)) for h in qrounds)),
+        "agg_overflow_total": int(sum(
+            int(h.get("n_agg_overflow", 0)) for h in qrounds)),
+    }
+    if converged is not None:
+        block["rounds_to_converge"] = \
+            len(history) if bool(converged) else None
+    return block

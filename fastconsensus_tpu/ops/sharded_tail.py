@@ -251,10 +251,17 @@ def _singleton_candidates(slab: GraphSlab, prev: GraphSlab):
 
 
 def _tail_local(slab: GraphSlab, labels: jax.Array, k_closure: jax.Array,
+                prev_labels: jax.Array,
                 *, n_p: int, tau: float, delta: float, n_closure: int,
-                cap_hint: int, hybrid_gate: bool,
+                cap_hint: int, hybrid_gate: bool, agg_gate: bool,
                 closure_tau=None):
-    """The per-shard tail program; see the module docstring."""
+    """The per-shard tail program; see the module docstring.
+
+    ``prev_labels`` is the previous round's labels (member-sharded like
+    ``labels``), consumed only by the fcqual churn metric; ``agg_gate``
+    is ``graph.agg_compaction_active`` evaluated by the caller on the
+    GLOBAL slab (the local chunk's capacity would mis-evaluate it).
+    """
     from fastconsensus_tpu.consensus import RoundStats
 
     from fastconsensus_tpu.ops import consensus_ops as cops
@@ -302,6 +309,69 @@ def _tail_local(slab: GraphSlab, labels: jax.Array, k_closure: jax.Array,
         n_hub_overflow = jnp.maximum(hub_mass - slab.hub_cap, 0)
     else:
         n_hub_overflow = jnp.int32(0)
+    if agg_gate:
+        # upper bound on what graph.compact_alive drops next round —
+        # mirrors consensus_tail's n_agg_overflow (global alive count)
+        n_agg_overflow = jnp.maximum(end_n_alive - slab.agg_cap, 0)
+    else:
+        n_agg_overflow = jnp.int32(0)
+
+    # --- fcqual quality bundle: the sharded mirror of obs/quality
+    # .tail_quality.  Same formulas, cross-shard reductions kept node-/
+    # scalar-/[n_p]-sized so the slab-sized-all-gather HLO pin
+    # (tests/test_parallel.py) still holds.  Float sums reduce in shard
+    # order — quality metrics are observability-only and never compared
+    # bit-wise across sharding layouts (only against a NumPy reference
+    # on the unsharded path, tests/test_quality.py).
+    f_np = jnp.float32(n_p)
+    alive = slab.alive
+    w_alive = jnp.where(alive, slab.weight, 0.0)
+    n_w_zero = jax.lax.psum(jnp.sum(
+        (alive & (slab.weight <= 0.0)).astype(jnp.int32)), EDGE_AXIS)
+    n_w_full = jax.lax.psum(jnp.sum(
+        (alive & (slab.weight >= f_np)).astype(jnp.int32)), EDGE_AXIS)
+    mid_end = alive & (slab.weight > 0) & (slab.weight < f_np)
+    one_mid = mid_end.astype(jnp.int32)
+    hits = _node_psum(one_mid, slab.src, mid_end, n) + \
+        _node_psum(one_mid, slab.dst, mid_end, n)
+    n_frontier = jnp.sum((hits > 0).astype(jnp.int32))
+    if n_p > 1:
+        # mean pairwise agreement over round-START alive edges, from the
+        # counts the update phase already contracted over "p"
+        pair = counts * (counts - 1.0) + \
+            (f_np - counts) * (f_np - counts - 1.0)
+        tot = jax.lax.psum(jnp.sum(jnp.where(prev.alive, pair, 0.0)),
+                           EDGE_AXIS)
+        n_start = jax.lax.psum(
+            jnp.sum(prev.alive.astype(jnp.int32)), EDGE_AXIS)
+        agreement = tot / (jnp.maximum(n_start.astype(jnp.float32), 1.0) *
+                           f_np * (f_np - 1.0))
+    else:
+        agreement = jnp.float32(1.0)
+    # per-member churn / modularity: member-local compute, one tiny
+    # tiled [n_p] all_gather over "p" to replicate the vectors
+    churn_local = jnp.sum((labels != prev_labels).astype(jnp.int32),
+                          axis=1)
+    labels_changed = jax.lax.all_gather(churn_local, ENSEMBLE_AXIS,
+                                        tiled=True)
+    total_w = jax.lax.psum(jnp.sum(w_alive), EDGE_AXIS)
+    w_safe = jnp.maximum(total_w, jnp.float32(1e-30))
+    str_n = _node_psum(w_alive, slab.src, alive, n) + \
+        _node_psum(w_alive, slab.dst, alive, n)
+    agree_m = labels[:, slab.src] == labels[:, slab.dst]
+    intra = jax.lax.psum(
+        jnp.sum(jnp.where(agree_m, w_alive[None, :], 0.0), axis=1),
+        EDGE_AXIS)
+
+    def _penalty(lab):
+        d_c = jnp.zeros((n,), jnp.float32).at[lab].add(str_n)
+        return jnp.sum((d_c / (2.0 * w_safe)) ** 2)
+
+    q_local = intra / w_safe - jax.vmap(_penalty)(labels)
+    q_local = jnp.where(total_w > 0.0, q_local, jnp.zeros_like(q_local))
+    member_modularity = jax.lax.all_gather(q_local, ENSEMBLE_AXIS,
+                                           tiled=True)
+
     stats = RoundStats(
         converged=mid_converged | end_converged,
         n_alive=end_n_alive,
@@ -311,7 +381,14 @@ def _tail_local(slab: GraphSlab, labels: jax.Array, k_closure: jax.Array,
         n_dropped=n_dropped,
         n_overflow=n_overflow,
         n_hub_overflow=n_hub_overflow,
+        n_agg_overflow=n_agg_overflow,
         cold=jnp.bool_(False),
+        n_w_zero=n_w_zero,
+        n_w_full=n_w_full,
+        n_frontier=n_frontier,
+        labels_changed=labels_changed,
+        member_modularity=member_modularity,
+        agreement=agreement,
     )
     return slab, stats
 
@@ -319,7 +396,7 @@ def _tail_local(slab: GraphSlab, labels: jax.Array, k_closure: jax.Array,
 def sharded_consensus_tail(slab: GraphSlab, labels: jax.Array,
                            k_closure: jax.Array, n_p: int, tau: float,
                            delta: float, n_closure: int, mesh,
-                           closure_tau=None
+                           closure_tau=None, prev_labels=None
                            ) -> Tuple[GraphSlab, "object"]:
     """Run the tail edge-locally over ``mesh`` (axes "p" x "e").
 
@@ -327,16 +404,27 @@ def sharded_consensus_tail(slab: GraphSlab, labels: jax.Array,
     replicated.  Bit-identical to :func:`consensus.consensus_tail` (see
     module docstring); with a 1-sized edge axis every "e" collective is a
     no-op and only the co-membership psum("p") remains.
+
+    ``prev_labels`` ([n_p, N], member-sharded like ``labels``) feeds the
+    fcqual churn metric only; None (round 0 / legacy callers) measures
+    churn against the singleton baseline, materialized here so the
+    shard_map operand list stays fixed-arity.
     """
+    from fastconsensus_tpu.graph import agg_compaction_active
     from fastconsensus_tpu.models.louvain import _cap_hint, select_move_path
 
+    if prev_labels is None:
+        prev_labels = jnp.broadcast_to(
+            jnp.arange(slab.n_nodes, dtype=jnp.int32), labels.shape)
     local = functools.partial(
         _tail_local, n_p=n_p, tau=tau, delta=delta,
         n_closure=n_closure, cap_hint=_cap_hint(slab),
         hybrid_gate=select_move_path(slab) == "hybrid",
+        agg_gate=agg_compaction_active(slab),
         closure_tau=closure_tau)
     specs = dict(mesh=mesh,
-                 in_specs=(P(EDGE_AXIS), P(ENSEMBLE_AXIS, None), P()),
+                 in_specs=(P(EDGE_AXIS), P(ENSEMBLE_AXIS, None), P(),
+                           P(ENSEMBLE_AXIS, None)),
                  out_specs=(P(EDGE_AXIS), P()))
     sm = getattr(jax, "shard_map", None)
     if sm is None:  # jax 0.4.x: experimental location
@@ -349,4 +437,4 @@ def sharded_consensus_tail(slab: GraphSlab, labels: jax.Array,
         fn = sm(local, check_vma=False, **specs)
     else:
         fn = sm(local, check_rep=False, **specs)
-    return fn(slab, labels, k_closure)
+    return fn(slab, labels, k_closure, prev_labels)

@@ -91,6 +91,56 @@ class JobTiming:
 
 
 @dataclasses.dataclass(frozen=True)
+class JobQuality:
+    """A finished job's fcqual convergence-quality block (``/result`` /
+    ``/status`` ``quality``), typed: how the consensus run converged —
+    final ensemble agreement / mean modularity, the active-frontier
+    trajectory (fraction of vertices still incident to a mid-weight
+    consensus edge, per round and averaged over the late half), total
+    label churn, and rounds-to-converge (None when the run hit
+    max_rounds unconverged).  Content-derived, so two jobs sharing one
+    cached result report the same block (contrast :class:`JobTiming`,
+    which is per submission)."""
+
+    rounds: int
+    final_agreement: Optional[float]
+    final_modularity_mean: Optional[float]
+    final_frontier_frac: Optional[float]
+    final_churn_frac: Optional[float]
+    late_frontier_frac: Optional[float]
+    frontier_frac_by_round: Tuple[float, ...]
+    agreement_by_round: Tuple[float, ...]
+    labels_changed_total: int
+    agg_overflow_total: int
+    rounds_to_converge: Optional[int]
+
+    @classmethod
+    def from_payload(cls, q: Dict[str, Any]) -> "JobQuality":
+        def _opt(key: str) -> Optional[float]:
+            v = q.get(key)
+            return None if v is None else float(v)
+
+        rtc = q.get("rounds_to_converge")
+        return cls(rounds=int(q.get("rounds", 0)),
+                   final_agreement=_opt("final_agreement"),
+                   final_modularity_mean=_opt("final_modularity_mean"),
+                   final_frontier_frac=_opt("final_frontier_frac"),
+                   final_churn_frac=_opt("final_churn_frac"),
+                   late_frontier_frac=_opt("late_frontier_frac"),
+                   frontier_frac_by_round=tuple(
+                       float(v) for v in
+                       q.get("frontier_frac_by_round") or ()),
+                   agreement_by_round=tuple(
+                       float(v) for v in
+                       q.get("agreement_by_round") or ()),
+                   labels_changed_total=int(
+                       q.get("labels_changed_total", 0)),
+                   agg_overflow_total=int(
+                       q.get("agg_overflow_total", 0)),
+                   rounds_to_converge=None if rtc is None else int(rtc))
+
+
+@dataclasses.dataclass(frozen=True)
 class PhaseLatency:
     """One fclat histogram from ``/metricsz``'s ``latency`` block: a
     log2-bucketed latency distribution (seconds) for one (name, tags)
@@ -340,6 +390,13 @@ class ServeClient:
         the job is still pending, or for pre-fclat servers)."""
         t = self.status(job_id).get("timing")
         return None if t is None else JobTiming.from_payload(t)
+
+    def quality(self, job_id: str) -> Optional[JobQuality]:
+        """A finished job's typed fcqual quality block (None while the
+        job is still pending, for pre-fcqual servers, and for results
+        computed from pre-fcqual checkpoint histories)."""
+        q = self.status(job_id).get("quality")
+        return None if q is None else JobQuality.from_payload(q)
 
     def coalescing(self) -> Dict[str, Any]:
         """Operator view of cross-request batching, extracted from

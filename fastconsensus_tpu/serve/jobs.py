@@ -407,6 +407,13 @@ class Job:
         the job finishes) carries the monotonic-derived durations."""
         timing = self.timing()   # takes the lock itself; compute first
         with self._lock:
+            # fcqual: the quality block is content-derived and rides the
+            # result payload (see server._finish_result) — surfacing it
+            # here keeps /status self-contained once the job is done,
+            # and it is small (scalars + per-round lists bounded by
+            # max_rounds), unlike the partitions we deliberately omit.
+            quality = (self.result or {}).get("quality") \
+                if self.state == STATE_DONE else None
             return {
                 "job_id": self.job_id,
                 "state": self.state,
@@ -426,4 +433,5 @@ class Job:
                 "requeues": self.requeues,
                 "excluded_devices": sorted(self._excluded),
                 "timing": timing,
+                "quality": quality,
             }

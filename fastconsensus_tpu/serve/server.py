@@ -750,7 +750,7 @@ class ConsensusService:
                     rounds=res.rounds, converged=res.converged,
                     compiles=guard.count, elapsed=elapsed,
                     batch_id=batch_id, batch_size=len(packed),
-                    worker=worker)
+                    worker=worker, history=res.history)
             job.stamp("fanned_out")
             job.mark(STATE_DONE, result=result)
             self._reg.inc("serve.jobs.completed")
@@ -765,9 +765,18 @@ class ConsensusService:
                        compiles: int, elapsed: float,
                        batch_id: Optional[str] = None,
                        batch_size: int = 1,
-                       worker=None) -> Dict[str, Any]:
+                       worker=None, history=None) -> Dict[str, Any]:
         """Slice off bucket padding, recompact ids, fill the cache —
-        the shared tail of the solo and batched execution paths."""
+        the shared tail of the solo and batched execution paths.
+
+        ``history`` (the run's per-round entries) yields the fcqual
+        ``quality`` block.  Unlike the fclat ``timing`` block — which is
+        per SUBMISSION and rides the Job — quality is derived from the
+        graph content, so it rides the CACHED result payload: a cache
+        hit returns the same quality block the computing job produced.
+        """
+        from fastconsensus_tpu.obs import quality as obs_quality
+
         partitions = []
         for p in partitions_raw:
             # fcheck: ok=sync-in-loop (partitions are already host numpy
@@ -786,6 +795,8 @@ class ConsensusService:
             "compiles": compiles,
             "elapsed_s": round(elapsed, 6),
             "cached": False,
+            "quality": obs_quality.summarize_history(
+                history or [], converged=converged),
         }
         if batch_id is not None:
             result["batch_id"] = batch_id
@@ -949,7 +960,8 @@ class ConsensusService:
                                      rounds=res.rounds,
                                      converged=res.converged,
                                      compiles=guard.count,
-                                     elapsed=elapsed, worker=worker)
+                                     elapsed=elapsed, worker=worker,
+                                     history=res.history)
         self._lat.hist("serve.job.seconds").record(elapsed)
         return result
 

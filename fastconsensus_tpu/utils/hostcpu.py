@@ -7,7 +7,7 @@ copy of the workaround — tests/conftest.py and __graft_entry__ carry
 historical inline variants with extra context-specific guards; new
 host-side scripts should call this.
 
-Call before the first jax backend initialization; asserts loudly if a
+Call before the first jax backend initialization; raises loudly if a
 backend is already up on something other than CPU (a silent TPU fallback
 is how the round-5 policy A/B initially contended with the 100k
 flagship run).
@@ -22,9 +22,33 @@ def force_cpu_backend() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["PALLAS_AXON_POOL_IPS"] = ""
     import jax
-    import jax._src.xla_bridge as _xb
 
-    if not _xb.backends_are_initialized():
-        _xb._backend_factories.pop("axon", None)
-        jax.config.update("jax_platforms", "cpu")
-    assert jax.default_backend() == "cpu", jax.default_backend()
+    try:
+        # private API: the plugin factory table is the only place the
+        # axon registration can be unhooked once sitecustomize ran
+        import jax._src.xla_bridge as _xb
+
+        if not _xb.backends_are_initialized():
+            _xb._backend_factories.pop("axon", None)
+            jax.config.update("jax_platforms", "cpu")
+    except (ImportError, AttributeError) as exc:
+        # a jax upgrade moved/renamed the private bridge module: the
+        # unhook silently not happening is exactly the silent-TPU-
+        # fallback failure mode this module exists to prevent, so fail
+        # loudly with the fix location instead of limping on
+        raise RuntimeError(
+            "hostcpu.force_cpu_backend could not reach "
+            "jax._src.xla_bridge to unhook the axon plugin factory — "
+            f"a jax upgrade likely moved the private API ({exc}); "
+            "update fastconsensus_tpu/utils/hostcpu.py for the new "
+            "layout") from exc
+    backend = jax.default_backend()
+    if backend != "cpu":
+        # not an assert: this must hold under `python -O` too — a
+        # silently optimized-away check here re-opens the round-5
+        # silent-TPU-contention incident
+        raise RuntimeError(
+            f"force_cpu_backend ran, but the jax backend is {backend!r} "
+            "(a backend was already initialized before the call, or the "
+            "plugin re-registered) — call force_cpu_backend before "
+            "anything touches jax devices")

@@ -109,6 +109,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             # change shows its mechanism (queue-wait vs device time)
             print()
             print(serve_load)
+        quality = history.quality_table(groups, markdown=args.markdown)
+        if quality:
+            # fcqual convergence-quality blocks (obs/quality.py): rounds
+            # to converge, ensemble agreement, and the active-frontier
+            # trajectory — the partition-quality axis the throughput
+            # table cannot see
+            print()
+            print(quality)
         fp_table = history.footprint_table(footprints,
                                            markdown=args.markdown)
         if fp_table:
@@ -122,6 +130,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # the fclat tail-latency gate (lower-is-better artifacts the
     # throughput rule above deliberately skips)
     problems += history.check_serve_load(groups)
+    # the fcqual partition-quality gate (rounds-to-converge growth,
+    # agreement drop, late-frontier growth)
+    problems += history.check_quality(groups)
     problems += history.check_footprints(footprints)
     n_recs = sum(len(r) for r in groups.values())
     if problems:

@@ -912,6 +912,89 @@ fi
 grep "header ok" "$SHAPE_DIR/bp.out"
 echo "fcshape smoke ok: coalescing, EDF gate, honest backpressure"
 
+echo "== fcqual: quality observability (round series + regression gate probe) =="
+QUAL_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR" "$SERVE_DIR" "$BATCH_DIR" "$POOL_DIR" "$AUTO_DIR" "$SL_DIR" "$SHAPE_DIR" "$QUAL_DIR"; [ -n "$SERVE_PID" ] && kill -9 "$SERVE_PID" 2>/dev/null' EXIT
+# (1) a traced karate run with the per-round JSONL sidecar: every round
+# entry must carry the fcqual quality keys with sane values, and the
+# active frontier must CONTRACT over the run (the monotone-ish
+# trajectory the frontier-mask sizing case rests on)
+JAX_PLATFORMS=cpu python -m fastconsensus_tpu.cli -f examples/karate_club.txt \
+    --alg louvain -np 4 --max-rounds 6 --seed 1 --quiet \
+    --out-dir "$QUAL_DIR" --trace-jsonl "$QUAL_DIR/rounds.jsonl"
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcqual karate run failed (exit $rc)" >&2
+    exit $rc
+fi
+python - "$QUAL_DIR/rounds.jsonl" <<'PYEOF'
+import json
+import sys
+
+recs = [json.loads(line) for line in open(sys.argv[1])]
+assert recs, "round JSONL recorded no rounds"
+needed = ("agreement", "frontier_frac", "churn_frac", "modularity_mean",
+          "n_frontier", "n_w_zero", "n_w_full", "labels_changed",
+          "labels_changed_by_member", "modularity_by_member",
+          "n_agg_overflow")
+for rec in recs:
+    for key in needed:
+        assert key in rec, (key, sorted(rec))
+    assert 0.0 <= rec["frontier_frac"] <= 1.0, rec
+    assert 0.0 <= rec["agreement"] <= 1.0, rec
+    assert 0.0 <= rec["churn_frac"], rec
+    assert rec["n_agg_overflow"] == 0, rec   # karate never compacts
+fronts = [rec["frontier_frac"] for rec in recs]
+late = fronts[len(fronts) // 2:]
+late_mean = sum(late) / len(late)
+# contraction, with slack for one-round wobble: the late-half mean and
+# the closing round must not exceed the opening round's frontier
+assert late_mean <= fronts[0] + 0.05, fronts
+assert fronts[-1] <= fronts[0] + 0.05, fronts
+print(f"fcqual series ok: {len(recs)} round(s), frontier "
+      f"{fronts[0]:.3f} -> {fronts[-1]:.3f} (late mean {late_mean:.3f}), "
+      f"final agreement {recs[-1]['agreement']:.3f}")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcqual round series failed its pins (exit $rc)" >&2
+    exit 1
+fi
+# (2) the committed quality artifact must parse and pass the gate...
+python scripts/bench_report.py --check --quiet \
+    runs/bench_lfr1k_quality_r12.json
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "bench_report --check failed on the committed quality artifact" \
+         "(exit $rc)" >&2
+    exit 1
+fi
+# ...and a synthetically quality-regressed copy one sequence later must
+# FAIL naming the quality rule (same contract as the serve_load probe:
+# a gate that cannot fail is no gate).  Throughput is left untouched so
+# only check_quality can produce the finding.
+python - runs/bench_lfr1k_quality_r12.json \
+    "$QUAL_DIR/bench_lfr1k_quality_r99.json" <<'PYEOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+q = doc["telemetry"]["quality"]
+q["final_agreement"] = round(max(q["final_agreement"] - 0.5, 0.0), 6)
+json.dump(doc, open(sys.argv[2], "w"))
+PYEOF
+out=$(python scripts/bench_report.py --check --quiet \
+    runs/bench_lfr1k_quality_r12.json \
+    "$QUAL_DIR/bench_lfr1k_quality_r99.json" 2>&1)
+rc=$?
+if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "quality.final_agreement"; then
+    echo "quality-regressed copy did not fail naming" \
+         "quality.final_agreement (exit $rc):" >&2
+    echo "$out" >&2
+    exit 1
+fi
+echo "fcqual smoke ok: round series sane, regressed copy fails naming its rule"
+
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"
     exit 0

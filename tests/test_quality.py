@@ -1,0 +1,476 @@
+"""fcqual quality observability (obs/quality.py + the engine threading).
+
+Covers the PR-12 acceptance pins:
+
+* the device-side metrics (weight bands, frontier, churn, agreement,
+  member modularity) against independent NumPy references on
+  karate-sized fixtures;
+* the zero-new-host-syncs contract: an instrumented 2-round run still
+  performs exactly the pre-fcqual sync set (block stats + final labels);
+* the per-round history schema and the run-level ``quality`` block
+  (summarize_history), including checkpoint/resume continuity;
+* the serve surface: ``/status`` quality block on finished jobs and the
+  jax-free typed-client parse;
+* the CI gate: a synthetically quality-regressed history record fails
+  ``check_quality`` naming its rule;
+* the satellite-3 resume-path message: a pre-closure_tau checkpoint is
+  rejected with wording that names the checkpoint-format migration.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def registry():
+    from fastconsensus_tpu.obs import get_registry
+
+    reg = get_registry()
+    reg.reset()
+    yield reg
+    reg.reset()
+
+
+def _fixture_slab(n_p=5, seed=7):
+    """A deterministic ~karate-sized slab with weights spanning all three
+    bands (0 / mid / >= n_p) and a few dead slots flipped back off."""
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.graph import pack_edges
+
+    n = 20
+    rng = np.random.default_rng(seed)
+    ring = np.stack([np.arange(n), (np.arange(n) + 1) % n], axis=1)
+    chords = np.stack([np.arange(0, n, 2), (np.arange(0, n, 2) + 5) % n],
+                      axis=1)
+    slab = pack_edges(np.concatenate([ring, chords]), n)
+    cap = slab.capacity
+    alive = np.asarray(slab.alive).copy()
+    # kill a couple of live slots so dead-slot masking is exercised
+    live_idx = np.flatnonzero(alive)
+    alive[live_idx[::7]] = False
+    # weights: cycle through 0, mid values, and the frozen pole
+    w = np.zeros(cap, np.float32)
+    w[live_idx] = np.float32(
+        rng.choice([0.0, 1.0, 2.5, n_p - 1, n_p], size=live_idx.size))
+    slab = dataclasses.replace(slab, alive=jnp.asarray(alive),
+                               weight=jnp.asarray(w))
+    labels = rng.integers(0, 4, size=(n_p, n)).astype(np.int32)
+    return slab, labels, n_p
+
+
+def _np_slab(slab):
+    return (np.asarray(slab.src), np.asarray(slab.dst),
+            np.asarray(slab.weight), np.asarray(slab.alive))
+
+
+# ------------------------------------------------- NumPy reference pins
+
+def test_weight_bands_and_frontier_match_numpy():
+    from fastconsensus_tpu.obs import quality as obs_quality
+
+    slab, _, n_p = _fixture_slab()
+    src, dst, w, alive = _np_slab(slab)
+    n_zero, n_full = obs_quality.weight_band_counts(slab, n_p)
+    assert int(n_zero) == int(np.sum(alive & (w <= 0.0)))
+    assert int(n_full) == int(np.sum(alive & (w >= n_p)))
+    # the three bands partition the alive edges
+    mid = alive & (w > 0) & (w < n_p)
+    assert int(n_zero) + int(n_full) + int(mid.sum()) == int(alive.sum())
+
+    mask = np.asarray(obs_quality.frontier_mask(slab, n_p))
+    ref = np.zeros(slab.n_nodes, bool)
+    ref[src[mid]] = True
+    ref[dst[mid]] = True
+    assert np.array_equal(mask, ref)
+    assert int(obs_quality.active_frontier(slab, n_p)) == int(ref.sum())
+
+
+def test_edge_agreement_matches_numpy():
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.obs import quality as obs_quality
+
+    slab, labels, n_p = _fixture_slab()
+    src, dst, _, alive = _np_slab(slab)
+    # per-edge co-membership counts, computed independently
+    c = np.sum(labels[:, src] == labels[:, dst], axis=0).astype(np.float64)
+    pair = c * (c - 1) + (n_p - c) * (n_p - c - 1)
+    ref = pair[alive].sum() / (max(alive.sum(), 1) * n_p * (n_p - 1))
+    got = obs_quality.edge_agreement(
+        jnp.asarray(c, jnp.float32), slab.alive, n_p)
+    assert got.dtype == jnp.float32
+    assert float(got) == pytest.approx(ref, rel=1e-5)
+    assert 0.0 <= float(got) <= 1.0
+    # n_p == 1 has no member pairs: defined as 1
+    assert float(obs_quality.edge_agreement(
+        jnp.asarray(c, jnp.float32), slab.alive, 1)) == 1.0
+
+
+def test_member_modularity_matches_numpy():
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.obs import quality as obs_quality
+
+    slab, labels, n_p = _fixture_slab()
+    src, dst, w, alive = _np_slab(slab)
+    wl = np.where(alive, w, 0.0).astype(np.float64)
+    total = wl.sum()
+    deg = np.zeros(slab.n_nodes)
+    np.add.at(deg, src, wl)
+    np.add.at(deg, dst, wl)
+    ref = []
+    for m in range(n_p):
+        lab = labels[m]
+        intra = wl[lab[src] == lab[dst]].sum()
+        d_c = np.zeros(slab.n_nodes)
+        np.add.at(d_c, lab, deg)
+        ref.append(intra / total - np.sum((d_c / (2 * total)) ** 2))
+    got = np.asarray(obs_quality.member_modularity(
+        slab, jnp.asarray(labels)))
+    assert got.shape == (n_p,)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    # an empty slab (W == 0) reports 0 for every member, not NaN
+    dead = dataclasses.replace(
+        slab, weight=jnp.zeros_like(slab.weight))
+    got0 = np.asarray(obs_quality.member_modularity(
+        dead, jnp.asarray(labels)))
+    assert np.array_equal(got0, np.zeros(n_p, np.float32))
+
+
+def test_label_churn_and_tail_quality_singleton_baseline():
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.obs import quality as obs_quality
+
+    slab, labels, n_p = _fixture_slab()
+    prev = labels.copy()
+    prev[0, :3] += 1      # member 0: 3 vertices moved
+    prev[2, 10] += 2      # member 2: 1 vertex moved
+    got = np.asarray(obs_quality.label_churn(
+        jnp.asarray(labels), jnp.asarray(prev)))
+    assert got.tolist() == [3, 0, 1, 0, 0]
+    # tail_quality with prev_labels=None measures against the singleton
+    # baseline (= the warm-start detection init)
+    c = jnp.zeros((slab.capacity,), jnp.float32)
+    qual = obs_quality.tail_quality(slab.alive, c, slab,
+                                    jnp.asarray(labels), None, n_p)
+    sing = np.arange(slab.n_nodes)[None, :]
+    ref = np.sum(labels != sing, axis=1)
+    assert np.array_equal(np.asarray(qual.labels_changed), ref)
+
+
+# ------------------------------------------- engine threading + syncs
+
+def test_round_entries_carry_quality_series(karate_slab, registry):
+    from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
+    from fastconsensus_tpu.models.registry import get_detector
+    from fastconsensus_tpu.obs import quality as obs_quality
+
+    cfg = ConsensusConfig(algorithm="louvain", n_p=6, tau=0.2,
+                          delta=0.02, max_rounds=3, seed=0)
+    res = run_consensus(karate_slab, get_detector("louvain"), cfg)
+    n = karate_slab.n_nodes
+    for entry in res.history:
+        for key in obs_quality.ENTRY_KEYS:
+            assert key in entry, key
+        assert entry["labels_changed"] == \
+            sum(entry["labels_changed_by_member"])
+        assert len(entry["labels_changed_by_member"]) == cfg.n_p
+        assert len(entry["modularity_by_member"]) == cfg.n_p
+        assert entry["frontier_frac"] == \
+            pytest.approx(entry["n_frontier"] / n, abs=1e-6)
+        assert 0.0 <= entry["agreement"] <= 1.0
+        assert 0.0 <= entry["frontier_frac"] <= 1.0
+        assert entry["n_agg_overflow"] == 0   # karate never compacts
+        # the three bands partition the alive edges
+        n_mid = entry["n_alive"] - entry["n_w_zero"] - entry["n_w_full"]
+        assert n_mid == entry["n_unconverged"]
+    # the fcobs series observed one sample per round
+    assert len(registry.series("consensus.quality.agreement")) == \
+        res.rounds
+    assert registry.counters()["quality.labels_changed_total"] == \
+        sum(h["labels_changed"] for h in res.history)
+
+
+def test_quality_rides_the_existing_syncs(karate_slab, registry):
+    """The zero-new-host-syncs acceptance pin: an instrumented 2-round
+    fused run performs EXACTLY the pre-fcqual deliberate sync set — one
+    block-stats readback and one final-labels fetch — with the whole
+    quality bundle riding inside the first."""
+    from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
+    from fastconsensus_tpu.models.registry import get_detector
+
+    cfg = ConsensusConfig(algorithm="louvain", n_p=6, tau=0.2,
+                          delta=0.02, max_rounds=2, seed=0)
+    res = run_consensus(karate_slab, get_detector("louvain"), cfg)
+    assert res.history[0]["agreement"] is not None  # instrumented
+    syncs = {k: v for k, v in registry.counters().items()
+             if k.startswith("host_sync.")}
+    assert syncs == {"host_sync.block_stats": 1,
+                     "host_sync.final_labels": 1,
+                     "host_sync.total": 2}, syncs
+
+
+# ---------------------------------------------------- run-level summary
+
+def _mk_history(fronts, agreements, churn=5):
+    return [{"round": i, "agreement": a, "frontier_frac": f,
+             "churn_frac": 0.01, "modularity_mean": 0.5,
+             "labels_changed": churn, "n_agg_overflow": 1}
+            for i, (f, a) in enumerate(zip(fronts, agreements))]
+
+
+def test_summarize_history_block():
+    from fastconsensus_tpu.obs import quality as obs_quality
+
+    hist = _mk_history([0.9, 0.5, 0.2, 0.1], [0.6, 0.8, 0.9, 0.95])
+    block = obs_quality.summarize_history(hist, converged=True)
+    assert block["rounds"] == 4
+    assert block["rounds_to_converge"] == 4
+    assert block["final_agreement"] == 0.95
+    assert block["final_frontier_frac"] == 0.1
+    assert block["frontier_frac_by_round"] == [0.9, 0.5, 0.2, 0.1]
+    assert block["late_frontier_frac"] == pytest.approx(0.15)
+    assert block["labels_changed_total"] == 20
+    assert block["agg_overflow_total"] == 4
+    # unconverged: rounds_to_converge is None, not max_rounds
+    assert obs_quality.summarize_history(
+        hist, converged=False)["rounds_to_converge"] is None
+    # pre-fcqual histories (no quality series) yield None, not a husk
+    assert obs_quality.summarize_history(
+        [{"round": 0, "n_alive": 3}], converged=True) is None
+    assert obs_quality.summarize_history([], converged=True) is None
+
+
+def test_checkpoint_resume_quality_continuity(tmp_path, registry):
+    """Resuming keeps the quality story cumulative: the resumed history
+    carries the quality series across the restart boundary, and the
+    registry's quality counters delta-restore so the run total equals
+    the sum over the WHOLE history (checkpointed + resumed rounds)."""
+    from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
+    from fastconsensus_tpu.graph import pack_edges
+    from fastconsensus_tpu.models.registry import get_detector
+    from fastconsensus_tpu.obs import quality as obs_quality
+
+    rng = np.random.default_rng(3)
+    n = 30
+    edges = np.unique(np.sort(rng.integers(0, n, (120, 2)), axis=1),
+                      axis=0)
+    edges = edges[edges[:, 0] != edges[:, 1]]
+    slab = pack_edges(edges, n)
+    detect = get_detector("louvain")
+    path = str(tmp_path / "ck.npz")
+    cfg1 = ConsensusConfig(algorithm="louvain", n_p=4, tau=0.2,
+                           delta=0.02, max_rounds=1, seed=5)
+    run_consensus(slab, detect, cfg1, checkpoint_path=path)
+    registry.reset()   # fresh process resumes
+    cfg = ConsensusConfig(algorithm="louvain", n_p=4, tau=0.2,
+                          delta=0.02, max_rounds=3, seed=5)
+    res = run_consensus(slab, detect, cfg, checkpoint_path=path,
+                        resume=True)
+    assert res.rounds > 1
+    for entry in res.history:
+        for key in obs_quality.ENTRY_KEYS:
+            assert key in entry, key
+    # delta restore: the registry total covers the pre-restart rounds too
+    assert registry.counters()["quality.labels_changed_total"] == \
+        sum(h["labels_changed"] for h in res.history)
+    block = obs_quality.summarize_history(res.history,
+                                          converged=bool(res.converged))
+    assert block["rounds"] == res.rounds
+    assert len(block["frontier_frac_by_round"]) == res.rounds
+
+
+def test_resume_rejects_pre_knob_checkpoint_naming_the_migration(
+        tmp_path):
+    """Satellite 3: resuming a checkpoint that PREDATES the closure_tau
+    knob with a bar set must fail saying the stored None came from the
+    checkpoint-format migration — not pretend the file recorded a
+    value."""
+    from fastconsensus_tpu.consensus import ConsensusConfig, run_consensus
+    from fastconsensus_tpu.graph import pack_edges
+    from fastconsensus_tpu.models.registry import get_detector
+
+    rng = np.random.default_rng(4)
+    n = 24
+    edges = np.unique(np.sort(rng.integers(0, n, (80, 2)), axis=1),
+                      axis=0)
+    edges = edges[edges[:, 0] != edges[:, 1]]
+    slab = pack_edges(edges, n)
+    detect = get_detector("lpm")
+    path = str(tmp_path / "ck.npz")
+    cfg1 = ConsensusConfig(algorithm="lpm", n_p=4, tau=0.5, delta=0.0,
+                           max_rounds=1, seed=3)
+    run_consensus(slab, detect, cfg1, checkpoint_path=path)
+    # strip the knob from the stored config: now a pre-r4 checkpoint
+    with np.load(path) as z:
+        arrays = {name: z[name].copy() for name in z.files}
+    meta = json.loads(bytes(arrays["meta"]).decode())
+    assert "closure_tau" in meta["extra"]
+    del meta["extra"]["closure_tau"]
+    arrays["meta"] = np.frombuffer(json.dumps(meta).encode(),
+                                   dtype=np.uint8)
+    np.savez(path, **arrays)
+
+    barred = ConsensusConfig(algorithm="lpm", n_p=4, tau=0.5, delta=0.0,
+                             max_rounds=2, seed=3, closure_tau=0.5)
+    with pytest.raises(ValueError,
+                       match="checkpoint-format migration"):
+        run_consensus(slab, detect, barred, checkpoint_path=path,
+                      resume=True)
+    # an EXPLICITLY stored mismatch keeps the plain wording: no false
+    # migration claim about a value the file really recorded
+    cfg_none = ConsensusConfig(algorithm="lpm", n_p=4, tau=0.5,
+                               delta=0.0, max_rounds=2, seed=3)
+    res = run_consensus(slab, detect, cfg_none, checkpoint_path=path,
+                        resume=True)   # migrated None == config None: ok
+    assert res.rounds >= 1
+    with pytest.raises(ValueError, match="was written with closure_tau"):
+        run_consensus(slab, detect, barred, checkpoint_path=path,
+                      resume=True)
+
+
+# -------------------------------------------------------- serve surface
+
+def test_job_status_carries_quality_once_done():
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.jobs import (STATE_DONE, STATE_RUNNING,
+                                              Job, JobSpec)
+
+    spec = JobSpec(edges=np.array([[0, 1], [1, 2]], dtype=np.int64),
+                   n_nodes=3, config=ConsensusConfig())
+    job = Job(spec)
+    assert job.describe()["quality"] is None   # nothing yet
+    job.mark(STATE_RUNNING)
+    qual = {"rounds": 2, "final_agreement": 0.9,
+            "frontier_frac_by_round": [0.8, 0.3],
+            "rounds_to_converge": 2}
+    job.mark(STATE_DONE, result={"partitions": [[0, 0, 1]],
+                                 "quality": qual})
+    desc = job.describe()
+    assert desc["quality"] == qual
+    # quality rides /status WITHOUT the result payload
+    assert "partitions" not in desc
+
+
+def test_quality_block_parses_in_jax_free_client():
+    """The typed client must parse the quality block with jax poisoned —
+    report tooling runs on boxes with no jax."""
+    canned = {
+        "rounds": 5, "final_agreement": 0.93,
+        "final_modularity_mean": 0.41, "final_frontier_frac": 0.12,
+        "final_churn_frac": 0.004, "late_frontier_frac": 0.18,
+        "frontier_frac_by_round": [0.9, 0.5, 0.3, 0.2, 0.12],
+        "agreement_by_round": [0.6, 0.7, 0.8, 0.9, 0.93],
+        "labels_changed_total": 412, "agg_overflow_total": 0,
+        "rounds_to_converge": None,
+    }
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "import json\n"
+        "from fastconsensus_tpu.serve.client import JobQuality\n"
+        f"q = json.loads({json.dumps(json.dumps(canned))})\n"
+        "jq = JobQuality.from_payload(q)\n"
+        "assert jq.rounds == 5 and jq.final_agreement == 0.93\n"
+        "assert jq.frontier_frac_by_round[-1] == 0.12\n"
+        "assert jq.rounds_to_converge is None\n"
+        "assert jq.late_frontier_frac == 0.18\n"
+        "assert jq.labels_changed_total == 412\n"
+        "print('jax-free quality parse ok')\n")
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, PYTHONPATH=os.path.abspath(root))
+    res = subprocess.run([sys.executable, "-c", code], cwd=root, env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "jax-free quality parse ok" in res.stdout
+
+
+# ------------------------------------------------------------- CI gate
+
+def _artifact(seq, quality, value=10.0):
+    return {
+        "metric": "consensus_partitions_per_sec_per_chip",
+        "value": value,
+        "unit": "partitions/s/chip (lfr=synthq, alg=louvain, n_p=4)",
+        "nmi": 0.9, "rounds": quality["rounds"], "converged": True,
+        "telemetry": {"compiles_warm": 0, "quality": quality},
+    }
+
+
+def _good_quality():
+    return {
+        "rounds": 4, "final_agreement": 0.92,
+        "final_modularity_mean": 0.5, "final_frontier_frac": 0.1,
+        "final_churn_frac": 0.01, "late_frontier_frac": 0.15,
+        "frontier_frac_by_round": [0.9, 0.4, 0.2, 0.1],
+        "agreement_by_round": [0.7, 0.8, 0.9, 0.92],
+        "labels_changed_total": 40, "agg_overflow_total": 0,
+        "rounds_to_converge": 4,
+    }
+
+
+def test_check_quality_fails_regressed_record_by_name(tmp_path):
+    """A synthetically quality-regressed newest record must fail the
+    gate with findings naming each quality rule; an unregressed copy
+    must pass."""
+    from fastconsensus_tpu.obs import history as obs_history
+
+    (tmp_path / "bench_synthq_r1.json").write_text(
+        json.dumps(_artifact(1, _good_quality())))
+    bad = _good_quality()
+    bad["final_agreement"] = 0.5          # drop 0.42 > 0.10
+    bad["rounds_to_converge"] = 20        # 5x > the 2x ceiling
+    bad["late_frontier_frac"] = 0.8       # growth 0.65 > 0.25
+    (tmp_path / "bench_synthq_r2.json").write_text(
+        json.dumps(_artifact(2, bad)))
+    groups = obs_history.build_history(
+        [str(tmp_path / "bench_synthq_r1.json"),
+         str(tmp_path / "bench_synthq_r2.json")])
+    problems = obs_history.check_quality(groups)
+    assert len(problems) == 3, problems
+    text = "\n".join(problems)
+    for rule in ("quality.final_agreement", "quality.rounds_to_converge",
+                 "quality.late_frontier_frac"):
+        assert rule in text, (rule, text)
+    # ...and the regressions are invisible to the throughput gate: only
+    # check_quality can catch them
+    assert obs_history.check_history(groups) == []
+
+    # the unregressed trajectory passes
+    (tmp_path / "bench_synthq_r2.json").write_text(
+        json.dumps(_artifact(2, _good_quality())))
+    groups = obs_history.build_history(
+        [str(tmp_path / "bench_synthq_r1.json"),
+         str(tmp_path / "bench_synthq_r2.json")])
+    assert obs_history.check_quality(groups) == []
+    # a single quality-carrying record has no trajectory: unarmed
+    groups = obs_history.build_history(
+        [str(tmp_path / "bench_synthq_r1.json")])
+    assert obs_history.check_quality(groups) == []
+
+
+def test_quality_table_renders(tmp_path):
+    from fastconsensus_tpu.obs import history as obs_history
+
+    (tmp_path / "bench_synthq_r1.json").write_text(
+        json.dumps(_artifact(1, _good_quality())))
+    groups = obs_history.build_history(
+        [str(tmp_path / "bench_synthq_r1.json")])
+    table = obs_history.quality_table(groups)
+    assert "synthq/louvain/np4 quality" in table
+    assert "late_frontier" in table and "0.92" in table
+    # pre-fcqual-only histories render nothing rather than a husk
+    (tmp_path / "bench_old_r1.json").write_text(json.dumps({
+        "metric": "consensus_partitions_per_sec_per_chip", "value": 5.0,
+        "unit": "partitions/s/chip (lfr=old, alg=louvain, n_p=4)"}))
+    groups = obs_history.build_history(
+        [str(tmp_path / "bench_old_r1.json")])
+    assert obs_history.quality_table(groups) == ""
