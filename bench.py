@@ -897,6 +897,16 @@ def bench_serve_load() -> int:
         if not drained:
             print("WARNING: serve_load drain timed out", file=sys.stderr)
 
+    # fcflight health of the whole sweep: a clean load run must never
+    # trip the hang watchdog (history.check_flight gates on this), and
+    # the exemplar count proves the tail-evidence machinery was live
+    # while costing nothing (bounded slots, no extra compiles).
+    flight_totals = reg.snapshot().get("counters", {})
+    flight_exemplars = sum(
+        len(slots)
+        for h in lat.snapshot()["histograms"]
+        if h["name"] == "serve.e2e"
+        for slots in (h.get("exemplars") or {}).values())
     ref_point = next(p for p in points if p["rps"] == reference_rps)
     consistency_ok = worst_consistency <= 0.05
     if not consistency_ok:
@@ -934,6 +944,12 @@ def bench_serve_load() -> int:
                 "queue_depth": queue_depth,
                 "max_batch": max_batch,
                 "points": points,
+            },
+            "flight": {
+                "watchdog_trips": flight_totals.get(
+                    "serve.flight.watchdog_trips", 0),
+                "bundles": flight_totals.get("serve.flight.bundles", 0),
+                "exemplars": flight_exemplars,
             },
         },
     }

@@ -262,7 +262,16 @@ def entry_points() -> List[EntryPoint]:
     # jax — its batch-ladder mirror is pinned against bucketer by test
     # so the jax-free guarantee survives ladder changes — and whose
     # only mutable state (the estimate cache) is guarded by one leaf
-    # lock the concurrency pass verifies without pragmas.
+    # lock the concurrency pass verifies without pragmas.  The fcflight
+    # additions are host-only by construction: obs/flight.py (stdlib
+    # per-thread event rings, one leaf lock per ring), obs/postmortem.py
+    # (bundle writer + jax-free render/diff reader — it must load with
+    # jax POISONED, the incident-analysis posture), and
+    # serve/watchdog.py (stdlib heartbeat table + poll thread; its only
+    # inputs are fclat service estimates and a clock).  None builds a
+    # jittable program; the AST lint and the concurrency pass cover all
+    # three, and the watchdog's device-call timing reads arrive through
+    # the fclat registry rather than any device sync of its own.
     assert available()  # registry import sanity
     return eps
 

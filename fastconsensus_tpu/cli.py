@@ -19,6 +19,7 @@ writes them for all).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 import sys
 import time
@@ -133,6 +134,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "profiler annotations (per-round steps) and the "
                         "profiler timeline is merged into the --trace "
                         "Perfetto artifact (host-only on CPU)")
+    p.add_argument("--dump-on-signal", action="store_true",
+                   help="install a SIGQUIT handler that dumps an "
+                        "fcflight post-mortem bundle (thread stacks, "
+                        "counters, flight events, the latest consensus "
+                        "round) and KEEPS RUNNING — `kill -QUIT <pid>` "
+                        "answers 'what is this run doing' without "
+                        "killing it; bundles land under FCTPU_FLIGHT_DIR "
+                        "(supervise exports it) else ./fcflight")
     return p
 
 
@@ -287,7 +296,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     from fastconsensus_tpu.obs.roundlog import RoundLog
 
     round_log = RoundLog(jsonl_path=args.trace_jsonl)
-    on_round = round_log.on_round
+    last_round: dict = {}
+    if args.dump_on_signal:
+        # fcflight for long non-serving runs: SIGQUIT dumps a bundle
+        # (stacks + counters + flight ring + the run's live state) and
+        # returns — the supervise stall kill sends exactly this signal
+        # before its SIGKILL, so a wedged supervised run leaves evidence
+        # naming the round it died in
+        from fastconsensus_tpu.obs import postmortem as obs_postmortem
+
+        def _collect() -> dict:
+            return {
+                "run": {
+                    "file": args.f,
+                    "config": dataclasses.asdict(cfg),
+                    "checkpoint": args.checkpoint,
+                    "resume": args.resume,
+                    "detect_cache": args.detect_cache,
+                },
+                "rounds": {"last": dict(last_round)},
+            }
+
+        obs_postmortem.install_signal_handler(
+            _collect, reason="sigquit",
+            on_written=lambda path: print(
+                f"fcflight bundle written to {path}", file=sys.stderr))
+
+    def on_round(entry):
+        last_round.clear()
+        last_round.update(entry)
+        round_log.on_round(entry)
     obs_tracer = None
     streamer = None
     trace_path = None
@@ -316,9 +354,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         from fastconsensus_tpu.obs.export import JsonlStreamer
 
         streamer = JsonlStreamer(trace_path + ".jsonl", obs_tracer)
+        base_on_round = on_round
 
         def on_round(entry):
-            round_log.on_round(entry)
+            base_on_round(entry)
             streamer.flush()
     t0 = time.perf_counter()
     run_ok = False

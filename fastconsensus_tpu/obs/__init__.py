@@ -44,6 +44,20 @@ Grown in PR 3 from a host tracer into the full stack:
   run-level ``telemetry.quality`` block that ``obs/history.py``'s
   ``check_quality`` gates in CI.
 
+* **obs/flight.py** — fcflight: the always-on flight recorder.
+  Bounded per-thread ring buffers of structured serving events
+  (admit/pop/hold, route, dequeue/device/device_done, shed/429,
+  cordon/requeue, watchdog trips, span mirror) with a hard memory cap
+  and an O(1) lock-leaf append, so the LAST few thousand events per
+  thread are always available to a post-mortem — black-box style, not
+  logging.
+* **obs/postmortem.py** — fcflight bundle writer + jax-free reader:
+  on SIGQUIT / watchdog trip / worker death / drain timeout, dump one
+  self-contained directory (flight rings, faulthandler thread stacks,
+  counter + latency snapshots, caller sections like the serve in-flight
+  jobs table) and read it back with
+  ``python -m fastconsensus_tpu.obs.postmortem render|diff``.
+
 Continuity: counter snapshots persist in checkpoint metadata
 (utils/checkpoint.py) and delta-restore on resume
 (``ObsRegistry.restore_counters``), and ``utils/supervise.py`` rotates
@@ -60,6 +74,8 @@ from fastconsensus_tpu.obs.counters import (ObsRegistry,  # noqa: F401
                                             device_memory, fold_round,
                                             get_registry, host_sync,
                                             record_device_memory)
+from fastconsensus_tpu.obs.flight import (FlightRecorder,  # noqa: F401
+                                          get_flight_recorder)
 from fastconsensus_tpu.obs.latency import (LatencyHistogram,  # noqa: F401
                                            LatencyRegistry,
                                            get_latency_registry)
@@ -72,5 +88,6 @@ __all__ = [
     "ObsRegistry", "get_registry", "host_sync", "fold_round",
     "device_memory", "record_device_memory",
     "LatencyHistogram", "LatencyRegistry", "get_latency_registry",
+    "FlightRecorder", "get_flight_recorder",
     "RoundLog", "phase_span",
 ]

@@ -317,6 +317,20 @@ def _profiler_records(path: str, segment: str, attempt: int,
     return out
 
 
+def flight_bundles(records: List[dict]) -> List[dict]:
+    """The fcflight post-mortem bundles a supervised run's telemetry
+    chain recorded, attempt-tagged: ``utils/supervise.py`` appends a
+    ``{"kind": "flight_bundle", "bundle": <dir>}`` line to a dead
+    attempt's JSONL segment before rotating it, and
+    :func:`read_jsonl_chain` carries those records through with the
+    segment's ``attempt`` — so "which attempts died, and where is each
+    one's crash evidence" is one list comprehension, not a directory
+    hunt.  Returns ``[{"attempt": k, "bundle": path}, ...]`` in chain
+    order."""
+    return [{"attempt": r.get("attempt"), "bundle": r.get("bundle")}
+            for r in records if r.get("kind") == "flight_bundle"]
+
+
 def summary_table(events: List[dict],
                   snapshot: Optional[dict] = None) -> str:
     """Aligned plain-text summary: span aggregates, then counters."""

@@ -166,6 +166,12 @@ def _normalize(rec: dict, source: str, seq: Optional[int]) -> dict:
         # per-RPS latency curve, kept verbatim for serve_load_table()
         # and check_serve_load()
         "serve_load": tel.get("serve_load") or None,
+        # fcflight incident-health block (bench.py serve_load): watchdog
+        # trips / bundles written / exemplar count, kept verbatim for
+        # check_flight() — a clean sequenced load run that TRIPS the
+        # hang watchdog is a serving regression even when the latency
+        # curve still passes
+        "flight": tel.get("flight") or None,
         # fcqual quality block (obs/quality.py summarize_history), kept
         # verbatim for quality_table() and check_quality(); None on
         # pre-fcqual artifacts
@@ -462,6 +468,37 @@ def check_serve_load(groups: Dict[str, List[dict]],
                         f"RPS ({ref}) grew more than {r429_growth} "
                         f"over the prior median {base:.3f} — the "
                         f"server sheds load it used to serve")
+    return problems
+
+
+def check_flight(groups: Dict[str, List[dict]]) -> List[str]:
+    """fcflight findings over sequenced records; [] means the gate
+    passes.  Unlike the trend gates this one is absolute, not
+    trajectory-based: a CLEAN sequenced load run (the CI serve_load
+    sweep drives moderate traffic at healthy RPS) must never trip the
+    hang watchdog — a trip means either a real stall in the serving
+    path or a watchdog threshold so tight it fires on healthy traffic,
+    and both block.  Only the newest sequence is judged (historic
+    records keep their trips as archaeology), and records without a
+    ``flight`` block (pre-fcflight artifacts) pass vacuously."""
+    problems: List[str] = []
+    for config, recs in groups.items():
+        seqd = [r for r in recs if r["seq"] is not None
+                and r.get("flight")]
+        if not seqd:
+            continue
+        latest_seq = max(r["seq"] for r in seqd)
+        for r in seqd:
+            if r["seq"] != latest_seq:
+                continue
+            trips = int((r.get("flight") or {}).get(
+                "watchdog_trips", 0) or 0)
+            if trips > 0:
+                problems.append(
+                    f"{config} [{r['source']} seq {r['seq']}]: the "
+                    f"hang watchdog tripped {trips} time(s) during a "
+                    f"clean sequenced load run — a serving stall or a "
+                    f"threshold regression (telemetry.flight)")
     return problems
 
 

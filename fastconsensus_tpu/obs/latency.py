@@ -59,6 +59,13 @@ MAX_EXP = 12
 N_BUCKETS = MAX_EXP - MIN_EXP + 2   # [<=2^MIN_EXP, ..., <=2^MAX_EXP, inf]
 _OVERFLOW = N_BUCKETS - 1
 
+# fcflight tail exemplars: per bucket, at most this many (id, value)
+# pairs ride the histogram — enough to link a bucket's outliers back to
+# their flight-recorder timelines, bounded so exemplars can never grow
+# the ~35-int histogram into a sample store.  The LARGEST values win a
+# slot: for a latency histogram the interesting exemplar is the worst.
+EXEMPLAR_SLOTS = 2
+
 
 def bucket_index(seconds: float) -> int:
     """Index of the log2 bucket holding ``seconds`` (>= 0)."""
@@ -98,9 +105,20 @@ class LatencyHistogram:
         self._sum = 0.0
         self._min: Optional[float] = None
         self._max: Optional[float] = None
+        # bucket index -> [(exemplar id, value), ...] (largest-value
+        # wins, at most EXEMPLAR_SLOTS per bucket — fcflight)
+        self._exemplars: Dict[int, List[Tuple[str, float]]] = {}
 
-    def record(self, seconds: float) -> None:
-        """Fold one observation (seconds; negatives clamp to 0)."""
+    def record(self, seconds: float,
+               exemplar: Optional[str] = None) -> None:
+        """Fold one observation (seconds; negatives clamp to 0).
+
+        ``exemplar`` (fcflight) attaches an identifier — the serving
+        layer passes the job id on ``serve.e2e`` — to the observation's
+        bucket: the largest :data:`EXEMPLAR_SLOTS` values per bucket
+        keep their ids, so a tail outlier stays traceable to its
+        flight-recorder timeline (``/debugz/slowest``) without the
+        histogram ever storing raw samples."""
         v = max(float(seconds), 0.0)
         idx = bucket_index(v)
         with self._lock:
@@ -111,27 +129,41 @@ class LatencyHistogram:
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
+            if exemplar is not None:
+                slots = self._exemplars.setdefault(idx, [])
+                slots.append((str(exemplar), v))
+                if len(slots) > EXEMPLAR_SLOTS:
+                    slots.sort(key=lambda s: s[1], reverse=True)
+                    del slots[EXEMPLAR_SLOTS:]
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-ready state: exact count/sum/min/max, bucketed
-        p50/p95/p99, and the sparse non-zero bucket counts (keyed by the
-        bucket's upper-edge exponent; ``"inf"`` for the overflow)."""
+        p50/p95/p99, the sparse non-zero bucket counts (keyed by the
+        bucket's upper-edge exponent; ``"inf"`` for the overflow), and
+        — when any observation carried one — the per-bucket exemplar
+        slots (same keying)."""
         with self._lock:
             buckets = list(self._buckets)
             count, total = self._count, self._sum
             vmin, vmax = self._min, self._max
-        return _snapshot_from(buckets, count, total, vmin, vmax)
+            exemplars = {i: list(s) for i, s in self._exemplars.items()}
+        return _snapshot_from(buckets, count, total, vmin, vmax,
+                              exemplars)
+
+
+def _bucket_key(index: int) -> str:
+    return "inf" if index == _OVERFLOW else str(MIN_EXP + index)
 
 
 def _snapshot_from(buckets: List[int], count: int, total: float,
-                   vmin: Optional[float],
-                   vmax: Optional[float]) -> Dict[str, Any]:
+                   vmin: Optional[float], vmax: Optional[float],
+                   exemplars: Optional[Dict[int, List[Tuple[str, float]]]]
+                   = None) -> Dict[str, Any]:
     sparse = {}
     for i, c in enumerate(buckets):
         if c:
-            key = "inf" if i == _OVERFLOW else str(MIN_EXP + i)
-            sparse[key] = c
-    return {
+            sparse[_bucket_key(i)] = c
+    out = {
         "count": count,
         "sum_s": round(total, 9),
         "min_s": None if vmin is None else round(vmin, 9),
@@ -141,6 +173,14 @@ def _snapshot_from(buckets: List[int], count: int, total: float,
         "p99_s": _quantile(buckets, count, vmax, 0.99),
         "buckets": sparse,
     }
+    if exemplars:
+        # Emitted only when an observation carried one, keyed like
+        # ``buckets``, value [id, seconds] — an optional sidecar so
+        # snapshots without exemplars stay byte-identical to before.
+        out["exemplars"] = {
+            _bucket_key(i): [[e, round(v, 9)] for e, v in slots]
+            for i, slots in sorted(exemplars.items()) if slots}
+    return out
 
 
 def _quantile(buckets: List[int], count: int, vmax: Optional[float],
@@ -178,6 +218,7 @@ def merge_snapshots(snaps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     count, total = 0, 0.0
     vmin: Optional[float] = None
     vmax: Optional[float] = None
+    exemplars: Dict[int, List[Tuple[str, float]]] = {}
     for snap in snaps:
         for i, c in enumerate(_dense_buckets(snap)):
             buckets[i] += c
@@ -189,7 +230,14 @@ def merge_snapshots(snaps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         v = snap.get("max_s")
         if v is not None:
             vmax = v if vmax is None else max(vmax, v)
-    return _snapshot_from(buckets, count, total, vmin, vmax)
+        for key, slots in (snap.get("exemplars") or {}).items():
+            idx = _OVERFLOW if key == "inf" else int(key) - MIN_EXP
+            merged = exemplars.setdefault(idx, [])
+            merged.extend((str(e), float(v)) for e, v in slots)
+            if len(merged) > EXEMPLAR_SLOTS:
+                merged.sort(key=lambda s: s[1], reverse=True)
+                del merged[EXEMPLAR_SLOTS:]
+    return _snapshot_from(buckets, count, total, vmin, vmax, exemplars)
 
 
 def diff_snapshots(new: Dict[str, Any],
@@ -204,8 +252,15 @@ def diff_snapshots(new: Dict[str, Any],
     count = max(int(new.get("count", 0)) - int(old.get("count", 0)), 0)
     total = max(float(new.get("sum_s", 0.0))
                 - float(old.get("sum_s", 0.0)), 0.0)
+    # Exemplar slots keep the largest values, so ``new``'s slots are a
+    # superset of the window's candidates — carry them through (same
+    # not-invertible-from-counts reasoning as min/max above).
+    exemplars: Dict[int, List[Tuple[str, float]]] = {}
+    for key, slots in (new.get("exemplars") or {}).items():
+        idx = _OVERFLOW if key == "inf" else int(key) - MIN_EXP
+        exemplars[idx] = [(str(e), float(v)) for e, v in slots]
     return _snapshot_from(buckets, count, total, new.get("min_s"),
-                          new.get("max_s"))
+                          new.get("max_s"), exemplars)
 
 
 class RateTracker:

@@ -42,6 +42,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from fastconsensus_tpu.obs import flight as obs_flight
+
 
 class _NullSpan:
     """Shared do-nothing context manager handed out by disabled tracers."""
@@ -75,6 +77,12 @@ class _Span:
         self._parent = stack[-1].name if stack else None
         self._depth = len(stack)
         stack.append(self)
+        # fcflight mirror: enabled-tracer spans also land in the flight
+        # recorder's ring, so a post-mortem bundle of a traced run shows
+        # the driver's phase structure next to the serving events.  One
+        # O(1) ring append; the disabled tracer never constructs a _Span
+        # so the overhead contract above is untouched.
+        obs_flight.record("span_open", name=self.name)
         self._t0 = time.perf_counter()
         self._cpu0 = time.process_time()
         return self
@@ -98,6 +106,8 @@ class _Span:
         if self.args:
             ev["args"] = self.args
         self._tracer._record(ev)
+        obs_flight.record("span_close", name=self.name,
+                          dur_us=ev["dur"])
         return False
 
 

@@ -128,6 +128,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-dir", type=str, default=None, metavar="DIR",
                    help="export the server's fcobs trace artifacts "
                         "(fcserve_trace.json + .jsonl) to DIR on drain")
+    p.add_argument("--flight-dir", type=str, default=None, metavar="DIR",
+                   help="where fcflight post-mortem bundles land "
+                        "(SIGQUIT / watchdog trip / worker death / "
+                        "drain timeout; default: FCTPU_FLIGHT_DIR, "
+                        "else ./fcflight)")
+    wd = ServeConfig().watchdog
+    p.add_argument("--watchdog-k", type=float, default=wd.k, metavar="K",
+                   help="hang watchdog: a device call is suspect past "
+                        "K x the bucket's measured service p95 "
+                        f"(default {wd.k:g})")
+    p.add_argument("--watchdog-floor-s", type=float, default=wd.floor_s,
+                   metavar="S",
+                   help="hang watchdog: never trip below S seconds "
+                        f"elapsed (default {wd.floor_s:g})")
+    p.add_argument("--no-watchdog", action="store_true",
+                   help="disable the hang watchdog (no suspect "
+                        "detection, no cordon-on-stall)")
+    p.add_argument("--watchdog-observe-only", action="store_true",
+                   help="watchdog trips count and write bundles but "
+                        "never cordon the worker (first-deploy posture)")
     p.add_argument("--quiet", action="store_true",
                    help="suppress startup/drain log lines")
     return p
@@ -256,6 +276,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         shed=not args.no_shed,
         max_hold_s=(args.hold_ms / 1000.0 if args.hold_ms
                     else shaping_defaults.max_hold_s))
+    from fastconsensus_tpu.serve.watchdog import WatchdogConfig
+
+    wd_defaults = WatchdogConfig()
+    watchdog = WatchdogConfig(
+        enabled=not args.no_watchdog,
+        k=args.watchdog_k,
+        floor_s=args.watchdog_floor_s,
+        min_history=wd_defaults.min_history,
+        poll_s=wd_defaults.poll_s,
+        cordon=not args.watchdog_observe_only)
+    try:
+        watchdog.validate()
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     cfg = ServeConfig(queue_depth=args.queue_depth,
                       cache_entries=args.cache_entries,
                       cache_ttl_s=args.cache_ttl,
@@ -272,7 +307,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                       huge_devices=args.huge_devices,
                       chip_max_edges=chip_max_edges,
                       spill_backlog=args.spill_backlog,
-                      shaping=shaping)
+                      shaping=shaping,
+                      watchdog=watchdog,
+                      flight_dir=args.flight_dir)
     try:
         service = ConsensusService(cfg).start()
     except ValueError as e:
@@ -306,6 +343,17 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     signal.signal(signal.SIGTERM, _on_signal)
     signal.signal(signal.SIGINT, _on_signal)
+    if hasattr(signal, "SIGQUIT"):
+        # fcflight: SIGQUIT = "dump a post-mortem bundle and KEEP
+        # serving" — the live-incident snapshot (contrast SIGTERM's
+        # drain).  Routed through the service so the bundle carries the
+        # full serving state and /healthz learns the path.
+        def _on_sigquit(signum, frame) -> None:
+            path = service.write_bundle("sigquit")
+            say(f"SIGQUIT: flight bundle "
+                f"{'failed' if path is None else path}")
+
+        signal.signal(signal.SIGQUIT, _on_sigquit)
     http_thread = threading.Thread(target=httpd.serve_forever,
                                    name="fcserve-http", daemon=True)
     http_thread.start()

@@ -225,6 +225,36 @@ class ShapingStats:
                    retry_after_hint_s=p.get("retry_after_hint_s"))
 
 
+@dataclasses.dataclass(frozen=True)
+class SlowJobExemplar:
+    """One ``/debugz/slowest`` row (obs/flight.py fcflight), typed: a
+    tail-latency exemplar — a worst-observed ``serve.e2e`` job id with
+    its latency and histogram tags — joined to its retained
+    flight-recorder timeline (``events``: ts/kind/aux dicts, oldest
+    first) and, while the server still tracks the job, its per-phase
+    timing block.  The answer to "why was THIS request the p99", one
+    HTTP GET away."""
+
+    job_id: str
+    e2e_s: float
+    bucket: Optional[str]
+    rung: Optional[str]
+    priority: Optional[str]
+    device: Optional[str]
+    events: Tuple[Dict[str, Any], ...]
+    timing: Optional[JobTiming] = None
+
+    @classmethod
+    def from_payload(cls, r: Dict[str, Any]) -> "SlowJobExemplar":
+        t = r.get("timing")
+        return cls(job_id=str(r["job_id"]), e2e_s=float(r["e2e_s"]),
+                   bucket=r.get("bucket"), rung=r.get("rung"),
+                   priority=r.get("priority"), device=r.get("device"),
+                   events=tuple(dict(e) for e in r.get("events") or ()),
+                   timing=None if t is None
+                   else JobTiming.from_payload(t))
+
+
 # What Backpressure.retry_after_s reports when the server sent no (or a
 # malformed) Retry-After — the pre-fcshape constant, kept as the
 # honest "we know nothing" floor.
@@ -384,6 +414,14 @@ class ServeClient:
         estimates, and the current Retry-After hint."""
         return ShapingStats.from_payload(
             self.metricsz().get("shaping", {}))
+
+    def slowest(self) -> List[SlowJobExemplar]:
+        """The server's worst observed end-to-end jobs
+        (``/debugz/slowest``), typed — tail exemplars with their flight
+        timelines, sorted slowest-first server-side."""
+        return [SlowJobExemplar.from_payload(r)
+                for r in self._request("/debugz/slowest")
+                .get("slowest", ())]
 
     def timing(self, job_id: str) -> Optional[JobTiming]:
         """A finished job's typed server-side timing block (None while

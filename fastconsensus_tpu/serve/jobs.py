@@ -380,6 +380,40 @@ class Job:
         phases["respond"] = max(end - prev, 0.0)
         return phases, max(end - admit, 0.0)
 
+    def phases_so_far(self) -> Dict[str, float]:
+        """Phase attribution in seconds that works MID-FLIGHT — the
+        fcflight in-flight jobs table (obs/postmortem.py bundles).
+
+        Same fold semantics as :meth:`phase_seconds` over the stamps
+        recorded so far, plus one OPEN interval from the last recorded
+        stamp to now, named for the phase the job is currently *in* (the
+        phase the next missing stamp would close) — so a job wedged in
+        the device call shows ``device: 312.4``, a heap-parked job shows
+        a growing ``queue_wait``, and a finished job matches
+        :meth:`phase_seconds` exactly.
+        """
+        with self._lock:
+            mono = dict(self._mono)
+        end = mono.get("finished", time.monotonic())
+        admit = mono["admit"]
+        phases: Dict[str, float] = {}
+        prev = admit
+        last_i = -1
+        for i, (phase, stamp_name) in enumerate(PHASE_STAMPS):
+            t = mono.get(stamp_name)
+            if t is None:
+                continue
+            phases[phase] = max(t - prev, 0.0)
+            prev = min(max(t, prev), end)
+            last_i = i
+        if "finished" in mono or last_i == len(PHASE_STAMPS) - 1:
+            open_name = "respond"
+        else:
+            open_name = PHASE_STAMPS[last_i + 1][0]
+        phases[open_name] = phases.get(open_name, 0.0) \
+            + max(end - prev, 0.0)
+        return phases
+
     def timing(self) -> Optional[Dict[str, Any]]:
         """JSON-ready server-side timing block for ``/status`` and
         ``/result`` (milliseconds, monotonic-derived): the per-phase

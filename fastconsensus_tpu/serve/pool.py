@@ -48,10 +48,14 @@ from collections import deque
 from typing import Dict, FrozenSet, List, Optional, Sequence
 
 from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs import flight as obs_flight
+from fastconsensus_tpu.obs import latency as obs_latency
 from fastconsensus_tpu.serve.jobs import (STATE_FAILED, STATE_QUEUED,
                                           STATE_RUNNING, Job)
 from fastconsensus_tpu.serve.scheduler import (NoEligibleWorker,
                                                StickyScheduler)
+from fastconsensus_tpu.serve.watchdog import (DISABLED_WATCHDOG,
+                                              HangWatchdog)
 
 _logger = logging.getLogger("fastconsensus_tpu")
 
@@ -292,12 +296,16 @@ class _Worker:
             # batch (after any _coalesce re-merge — ride-alongs merged
             # from later deque entries stamp here too)
             job.stamp("dequeued")
+        obs_flight.record("dequeue", device=self.idx, n_jobs=len(batch))
+        self.pool.watchdog.beat(self.idx, "dequeue",
+                                n_jobs=len(batch))
         t0 = time.perf_counter()
         with self._cond:
             self._running = True
         try:
             self.service._drain_group(deque(batch), worker=self)
         finally:
+            self.pool.watchdog.beat(self.idx, "idle")
             with self._cond:
                 self._running = False
                 self.busy_s += time.perf_counter() - t0
@@ -312,6 +320,8 @@ class _Worker:
             self.error = f"{type(exc).__name__}: {exc}"
         self._reg.inc("serve.pool.worker_deaths")
         self._reg.inc(f"serve.device.{self.idx}.deaths")
+        obs_flight.record("cordon", device=self.idx, reason="death",
+                          error=f"{type(exc).__name__}: {exc}")
         _logger.exception(
             "fcpool worker %d (%s) died; cordoning the device and "
             "requeueing its jobs", self.idx, self.kind)
@@ -319,6 +329,40 @@ class _Worker:
         with self._cond:
             while self._batches:
                 pending.extend(self._batches.popleft())
+        self._requeue_pending(pending)
+        self.service._on_worker_death(self, exc)
+
+    def cordon(self, reason: str) -> None:
+        """Externally cordon this worker — the hang watchdog's
+        cordon-on-stall path.  A hung worker cannot run its own
+        ``_die`` (its thread is wedged inside a device call), so the
+        WATCHDOG thread flips the cordon flag and requeues the deque
+        backlog onto surviving devices with this one excluded.  The
+        in-flight batch stays with the stuck thread: it either finishes
+        late (the worker completes it but — cordoned — takes no new
+        work) or never, and its jobs stay visible in the in-flight
+        table either way."""
+        with self._cond:
+            if self.cordoned:
+                return
+            self.cordoned = True
+            self.error = reason
+            pending: List[Job] = []
+            while self._batches:
+                pending.extend(self._batches.popleft())
+        self._reg.inc("serve.pool.worker_cordons")
+        self._reg.inc(f"serve.device.{self.idx}.cordons")
+        obs_flight.record("cordon", device=self.idx, reason="watchdog",
+                          error=reason)
+        _logger.warning(
+            "fcpool worker %d (%s) cordoned: %s (requeueing %d backlog "
+            "job(s))", self.idx, self.kind, reason, len(pending))
+        self._requeue_pending(pending)
+
+    def _requeue_pending(self, pending: List[Job]) -> None:
+        """The shared cordon tail (worker death and watchdog cordon):
+        re-admit this worker's unfinished backlog with the device
+        excluded, so the survivors carry the traffic."""
         requeue = [j for j in pending
                    if j.state in (STATE_QUEUED, STATE_RUNNING)]
         for job in requeue:
@@ -326,6 +370,8 @@ class _Worker:
             job.mark(STATE_QUEUED)
         if requeue:
             self._reg.inc("serve.pool.requeued_jobs", len(requeue))
+            obs_flight.record("requeue", device=self.idx,
+                              n_jobs=len(requeue))
             self.pool.requeue(requeue)
 
     def describe(self) -> dict:
@@ -452,6 +498,16 @@ class WorkerPool:
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="fcpool-dispatch",
             daemon=True)
+        # fcflight: the hang watchdog thread (serve/watchdog.py) — the
+        # disabled singleton keeps every beat/describe call site
+        # unconditional, like the disabled tracer
+        wd_cfg = cfg.watchdog
+        if wd_cfg is not None and wd_cfg.enabled:
+            self.watchdog = HangWatchdog(
+                obs_latency.get_latency_registry(), wd_cfg,
+                on_trip=service._on_watchdog_trip)
+        else:
+            self.watchdog = DISABLED_WATCHDOG
 
     # -- lifecycle ---------------------------------------------------
 
@@ -464,6 +520,7 @@ class WorkerPool:
         for w in self.workers:
             w.start()
         self._dispatcher.start()
+        self.watchdog.start()
         self._reg.gauge("serve.pool.workers", len(self.workers))
 
     def backlog(self) -> int:
@@ -487,6 +544,9 @@ class WorkerPool:
     def drain(self, timeout: Optional[float]) -> bool:
         """Join the dispatcher and every worker (the queue must already
         be closed — ConsensusService.begin_drain).  True = all exited."""
+        # no trips during shutdown: a drain that exceeds its deadline is
+        # the DRAIN-TIMEOUT incident (its own bundle), not a hang
+        self.watchdog.stop()
         deadline = None if timeout is None \
             else time.monotonic() + timeout
         remaining = lambda: (None if deadline is None else  # noqa: E731
@@ -615,6 +675,14 @@ class WorkerPool:
             w.close()
 
     # -- introspection ------------------------------------------------
+
+    def worker_for(self, idx: int) -> Optional[_Worker]:
+        """Worker by device ordinal (the watchdog trip dict's
+        ``device`` field) — the cordon-on-stall lookup."""
+        for w in self.workers:
+            if w.idx == idx:
+                return w
+        return None
 
     def describe(self) -> List[dict]:
         return [w.describe() for w in self.workers]

@@ -52,6 +52,7 @@ import threading
 from typing import Callable, List, Optional, Tuple
 
 from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs import flight as obs_flight
 from fastconsensus_tpu.serve.jobs import Job
 
 
@@ -142,6 +143,10 @@ class AdmissionQueue:
                                        if self._extra_depth else 0)
             if depth >= self.max_depth:
                 self._reg.inc("serve.queue.rejected_full")
+                # fcflight: 429s are exactly the events a post-incident
+                # timeline needs next to the hangs that caused them
+                obs_flight.record("reject_429", job=job.job_id,
+                                  depth=depth)
                 raise QueueFull(depth, self.max_depth)
             self._seq += 1
             heapq.heappush(
@@ -150,8 +155,14 @@ class AdmissionQueue:
                  job.deadline_mono if self.edf else 0.0,
                  self._seq, job))
             self._reg.inc("serve.queue.admitted")
-            self._reg.gauge("serve.queue.depth", len(self._heap))
+            depth = len(self._heap)
+            self._reg.gauge("serve.queue.depth", depth)
             self._cond.notify()
+        # flight append outside _cond: admits race the dispatcher's
+        # pop for this lock, and the timeline doesn't need the
+        # critical section — only the depth observed inside it
+        obs_flight.record("admit", job=job.job_id,
+                          priority=job.spec.priority, depth=depth)
 
     def _note_promotion(self, heap, popped_seq: int,
                         priority: int) -> None:
@@ -190,6 +201,8 @@ class AdmissionQueue:
                     t_pop = time.monotonic()
                     job.stamp_hold(t_pop)
                     job.stamp("dispatched", at=t_pop)
+                    obs_flight.record("pop", job=job.job_id,
+                                      depth=len(self._heap))
                     return job
                 if self._closed:
                     return None
@@ -327,6 +340,13 @@ class AdmissionQueue:
                         # to the coalesced pop they leave the heap in
                         t.stamp_hold(t_hold)
                         t.stamp("dispatched", at=t_pop)
+                        obs_flight.record("pop", job=t.job_id,
+                                          n=len(taken))
+                    if hold_began is not None:
+                        obs_flight.record(
+                            "hold", job=head.job_id,
+                            held_s=round(t_pop - hold_began, 6),
+                            n=len(taken))
                     return taken
                 if self._closed:
                     return None

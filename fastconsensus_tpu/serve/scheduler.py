@@ -42,6 +42,7 @@ import threading
 from typing import Dict, FrozenSet, Optional, Sequence
 
 from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs import flight as obs_flight
 from fastconsensus_tpu.obs import latency as obs_latency
 
 
@@ -100,6 +101,9 @@ class StickyScheduler:
                         None)
             if home is not None and home.load() <= self.spill_backlog:
                 self._reg.inc("serve.sched.sticky_hits")
+                obs_flight.record("route", bucket=bucket,
+                                  device=home.idx, via="sticky",
+                                  n_jobs=n_jobs)
                 return home
             # spill (home overloaded) or first/renewed assignment (no
             # home, or the home is cordoned/excluded): least-loaded,
@@ -113,13 +117,18 @@ class StickyScheduler:
                 # sticky home minted where the bucket will compile
                 self._affinity[bucket] = pick.idx
                 self._reg.inc("serve.sched.assigns")
+                via = "assign"
             elif home is None:
                 # the recorded home is cordoned/excluded: re-home the
                 # bucket where its work lands now
                 self._affinity[bucket] = pick.idx
                 self._reg.inc("serve.sched.rehomes")
+                via = "rehome"
             else:
                 self._reg.inc("serve.sched.spills")
                 if not pick.is_warm(bucket):
                     self._reg.inc("serve.sched.spill_cold")
+                via = "spill"
+            obs_flight.record("route", bucket=bucket, device=pick.idx,
+                              via=via, n_jobs=n_jobs)
             return pick

@@ -71,7 +71,9 @@ import numpy as np
 
 from fastconsensus_tpu.cli import ALGORITHMS, DEFAULT_TAU
 from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs import flight as obs_flight
 from fastconsensus_tpu.obs import latency as obs_latency
+from fastconsensus_tpu.obs import postmortem as obs_postmortem
 from fastconsensus_tpu.obs.tracer import get_tracer
 from fastconsensus_tpu.serve import bucketer
 from fastconsensus_tpu.serve.jobs import (PRIORITY_BATCH,
@@ -85,6 +87,7 @@ from fastconsensus_tpu.serve.queue import (AdmissionQueue, DeadlineShed,
                                            QueueClosed, QueueFull)
 from fastconsensus_tpu.serve.cache import ResultCache
 from fastconsensus_tpu.serve.shaping import ShapingConfig, TrafficShaper
+from fastconsensus_tpu.serve.watchdog import WatchdogConfig
 
 _logger = logging.getLogger("fastconsensus_tpu")
 
@@ -173,6 +176,14 @@ class ServeConfig:
     # config enables all three arms; ShapingConfig is frozen, so the
     # shared default instance is safe.
     shaping: ShapingConfig = ShapingConfig()
+    # fcflight hang watchdog (serve/watchdog.py): heartbeat-based
+    # wedged-device detection with cordon-on-stall.  None or
+    # enabled=False disables the watchdog thread entirely (the
+    # DISABLED_WATCHDOG no-op keeps every call site unconditional).
+    watchdog: Optional[WatchdogConfig] = WatchdogConfig()
+    # Where post-mortem bundles land (obs/postmortem.py): None falls
+    # back to $FCTPU_FLIGHT_DIR, else ./fcflight.
+    flight_dir: Optional[str] = None
 
 
 def validate_warm_specs(config: ServeConfig) -> None:
@@ -240,6 +251,20 @@ class ConsensusService:
         self._prewarm_total = len(self.config.prewarm)
         self._prewarm_done = 0
         self._prewarm_finished = self._prewarm_total == 0
+        # fcflight: last post-mortem bundle path (guarded by self._lock
+        # — the watchdog thread writes it, /healthz handlers read it)
+        self._last_bundle: Optional[str] = None
+        # Hang-injection test hook (tests + the CI fcflight smoke): the
+        # FCTPU_TEST_HANG_AFTER-th device dispatch sleeps
+        # FCTPU_TEST_HANG_S seconds inside the watchdog's "device"
+        # heartbeat window, exactly once per process — a deterministic
+        # wedge the watchdog must catch while earlier traffic builds
+        # the service-time history it judges against.
+        self._hang_s = float(os.environ.get("FCTPU_TEST_HANG_S", "0")
+                             or 0.0)
+        self._hang_after = int(os.environ.get("FCTPU_TEST_HANG_AFTER",
+                                              "0") or 0)
+        self._hang_seq = itertools.count()
 
     # -- lifecycle ---------------------------------------------------
 
@@ -321,7 +346,187 @@ class ConsensusService:
             _logger.warning(
                 "fcserve drain timed out with a job in flight; "
                 "skipping trace export (streamed .jsonl is intact)")
+            # a drain that refuses to finish IS an incident: dump the
+            # in-flight table, thread stacks and event rings while the
+            # wedged state still exists to photograph
+            self.write_bundle("drain_timeout")
         return ok
+
+    # -- fcflight incident hooks --------------------------------------
+
+    def bundle_sections(self) -> Dict[str, Any]:
+        """The serving layer's post-mortem sections (obs/postmortem.py
+        adds flight/counters/latency/stacks on top): the resolved
+        config, the in-flight jobs table with per-job phase timelines,
+        and the pool / scheduler / queue / watchdog / shaping state."""
+        sections: Dict[str, Any] = {
+            "config": dataclasses.asdict(self.config),
+            "jobs": self._jobs_section(),
+        }
+        try:
+            sections["queue"] = {
+                "depth": self.queue.depth(),
+                "total_depth": self.queue.total_depth(),
+                "max_depth": self.queue.max_depth,
+                "draining": self.queue.draining(),
+            }
+            if self.pool is not None:
+                sections["pool"] = self.pool.describe()
+                sections["scheduler"] = {
+                    "affinity": self.pool.scheduler.affinity()}
+                sections["watchdog"] = self.pool.watchdog.describe()
+            sections["shaping"] = self.shaping_stats()
+        except Exception as exc:  # noqa: BLE001 — a half-wedged server
+            # must still dump what it can collect
+            sections["sections_error"] = {"error": repr(exc)}
+        return sections
+
+    def _jobs_section(self) -> Dict[str, Any]:
+        """In-flight jobs with open-ended phase timelines — the bundle
+        row the reader prints as 'where is this job's lifetime
+        accumulating' (a wedged job shows device=312.4s)."""
+        with self._lock:
+            jobs = list(self._jobs.values())
+        rows: List[Dict[str, Any]] = []
+        for j in jobs:
+            if j.state not in (STATE_QUEUED, STATE_RUNNING):
+                continue
+            try:
+                bucket = j.spec.bucket().key()
+            except Exception:  # noqa: BLE001 — unbucketable specs
+                bucket = "-"   # still belong in the incident table
+            rows.append({
+                "job_id": j.job_id,
+                "state": j.state,
+                "bucket": bucket,
+                "priority": j.spec.priority,
+                "device": j.device,
+                "batch_id": j.batch_id,
+                "requeues": j.requeues,
+                "phases_s": {k: round(v, 6)
+                             for k, v in j.phases_so_far().items()},
+            })
+        return {"tracked": len(jobs), "in_flight": len(rows),
+                "jobs": rows}
+
+    def write_bundle(self, reason: str) -> Optional[str]:
+        """Dump one post-mortem bundle (never raises — an incident dump
+        that throws during the incident is worse than none)."""
+        try:
+            path = obs_postmortem.write_bundle(
+                reason, self.bundle_sections(),
+                base_dir=self.config.flight_dir)
+        except Exception:  # noqa: BLE001
+            _logger.exception("fcflight: bundle write failed (reason=%s)",
+                              reason)
+            return None
+        self._reg.inc("serve.flight.bundles")
+        obs_flight.record("bundle", reason=reason, path=path)
+        with self._lock:
+            self._last_bundle = path
+        _logger.warning("fcflight: post-mortem bundle written to %s "
+                        "(reason=%s)", path, reason)
+        return path
+
+    def _on_watchdog_trip(self, trip: Dict[str, Any]) -> None:
+        """Watchdog-thread callback, once per suspect episode: count,
+        record, bundle, then cordon through the PR 6 machinery (unless
+        ``watchdog.cordon=False`` — observe-only)."""
+        wd = self.config.watchdog
+        cordon = wd is not None and wd.cordon
+        self._reg.inc("serve.flight.watchdog_trips")
+        obs_flight.record("watchdog_trip", job=trip.get("job"),
+                          device=trip.get("device"),
+                          bucket=trip.get("bucket"),
+                          elapsed_s=trip.get("elapsed_s"),
+                          threshold_s=trip.get("threshold_s"))
+        _logger.error(
+            "fcflight watchdog: device %s wedged %.1fs inside a device "
+            "call (threshold %.1fs, job %s)%s", trip.get("device"),
+            trip.get("elapsed_s") or 0.0, trip.get("threshold_s") or 0.0,
+            trip.get("job"), "; cordoning" if cordon else
+            " (observe-only: cordon disabled)")
+        self.write_bundle(f"watchdog_d{trip.get('device')}")
+        if cordon and self.pool is not None:
+            worker = self.pool.worker_for(trip["device"])
+            if worker is not None:
+                worker.cordon(
+                    f"hang watchdog: device call exceeded "
+                    f"{trip.get('threshold_s')}s (job {trip.get('job')})")
+
+    def _on_worker_death(self, worker, exc: Exception) -> None:
+        """Pool callback after a worker's ``_die`` cordoned it and
+        requeued its backlog: photograph the process while the broken
+        state is fresh."""
+        self.write_bundle(f"worker_death_d{worker.idx}")
+
+    def slowest(self, limit: int = 8) -> Dict[str, Any]:
+        """The ``/debugz/slowest`` payload: the worst ``serve.e2e``
+        exemplars (job id + latency, per bucket/rung/device tags)
+        joined to their retained flight-recorder timelines and — while
+        the jobs table still holds them — their phase breakdowns.  The
+        answer to "why was THIS request the p99"."""
+        snap = self._lat.snapshot()
+        rows: List[Dict[str, Any]] = []
+        for h in snap.get("histograms", ()):
+            if h.get("name") != "serve.e2e":
+                continue
+            tags = h.get("tags") or {}
+            for slots in (h.get("exemplars") or {}).values():
+                for job_id, secs in slots:
+                    rows.append({
+                        "job_id": job_id,
+                        "e2e_s": secs,
+                        "bucket": tags.get("bucket"),
+                        "rung": tags.get("rung"),
+                        "priority": tags.get("priority"),
+                        "device": tags.get("device"),
+                    })
+        rows.sort(key=lambda r: -float(r["e2e_s"]))
+        del rows[max(int(limit), 1):]
+        recorder = obs_flight.get_flight_recorder()
+        for r in rows:
+            r["events"] = recorder.events(job=r["job_id"], limit=64)
+            job = self.job(r["job_id"])
+            if job is not None:
+                r["timing"] = job.timing()
+        return {"slowest": rows}
+
+    # -- fcflight device-call instrumentation -------------------------
+
+    def _device_begin(self, worker, job_id: Optional[str],
+                      bucket_name: str, n_jobs: int = 1) -> bool:
+        """Open the watchdog's "device" heartbeat window and record the
+        flight event; returns the cold-compile prediction (bucket not
+        warm on that worker — the watchdog exemption, and the honest
+        tag for the flight timeline)."""
+        cold = worker is not None and not worker.is_warm(bucket_name)
+        obs_flight.record("device", job=job_id,
+                          device=None if worker is None else worker.idx,
+                          bucket=bucket_name, cold=cold, n_jobs=n_jobs)
+        if worker is not None and self.pool is not None:
+            self.pool.watchdog.beat(worker.idx, "device", job=job_id,
+                                    bucket=bucket_name, cold=cold,
+                                    n_jobs=n_jobs)
+        self._maybe_test_hang()
+        return cold
+
+    def _device_end(self, worker, job_id: Optional[str],
+                    bucket_name: str) -> None:
+        if worker is not None and self.pool is not None:
+            self.pool.watchdog.beat(worker.idx, "device_done")
+        obs_flight.record("device_done", job=job_id,
+                          device=None if worker is None else worker.idx,
+                          bucket=bucket_name)
+
+    def _maybe_test_hang(self) -> None:
+        if self._hang_s <= 0.0:
+            return
+        if next(self._hang_seq) == self._hang_after:
+            _logger.warning(
+                "fcflight TEST hook: injecting a %.1fs hang inside the "
+                "device window (FCTPU_TEST_HANG_S)", self._hang_s)
+            time.sleep(self._hang_s)
 
     def _flush_trace(self) -> None:
         """Stream newly finished spans to the .jsonl (once per batch)
@@ -415,6 +620,8 @@ class ConsensusService:
             job.mark(STATE_DONE, result=dict(cached, cached=True))
             self._remember(job)
             self._reg.inc("serve.jobs.cached")
+            obs_flight.record("cache_hit", job=job.job_id,
+                              bucket=bucket_key)
             self._record_timeline(job, cached=True)
             return job
         # fcshape deadline-aware shedding: a job the measured service
@@ -429,6 +636,8 @@ class ConsensusService:
                                              job.deadline_mono, depth)
             if reason is not None:
                 self._reg.inc("serve.queue.rejected_shed")
+                obs_flight.record("shed", job=job.job_id,
+                                  bucket=bucket_key, depth=depth)
                 shed = DeadlineShed(depth, self.queue.max_depth, reason)
                 shed.retry_after_s = self.shaper.retry_after_s(
                     depth, bucket_key)
@@ -529,6 +738,7 @@ class ConsensusService:
                            priority=job.spec.priority).record(e2e)
             self._reg.inc("serve.slo.missed")
             self._reg.inc(f"serve.slo.{cls}.missed")
+            obs_flight.record("fail", job=job.job_id, bucket=bucket_key)
             return
         tags = dict(bucket=bucket_key, rung=0 if cached else int(rung),
                     priority=job.spec.priority, device=device)
@@ -547,7 +757,14 @@ class ConsensusService:
                 # 99% synthetic zeros measures nothing
                 continue
             self._lat.hist(f"serve.phase.{name}", **tags).record(secs)
-        self._lat.hist("serve.e2e", **tags).record(e2e)
+        # fcflight: the job id rides the e2e observation as a bounded
+        # per-bucket exemplar — /debugz/slowest joins the bucket's worst
+        # latencies back to their flight timelines by exactly this id
+        self._lat.hist("serve.e2e", **tags).record(
+            e2e, exemplar=job.job_id)
+        obs_flight.record("finish", job=job.job_id, bucket=bucket_key,
+                          e2e_s=round(e2e, 6),
+                          rung=0 if cached else int(rung))
         verdict = "met" if e2e * 1000.0 <= job.spec.slo_target() \
             else "missed"
         self._reg.inc(f"serve.slo.{verdict}")
@@ -705,14 +922,22 @@ class ConsensusService:
         guard = CompileGuard(registry=self._reg,
                              counter="serve.xla_compiles",
                              thread_ident=threading.get_ident())
+        head_id = packed[0][0].job_id
+        self._device_begin(worker, head_id, bucket.key(),
+                           n_jobs=len(packed))
         try:
-            with tracer.span("serve.batch", bucket=bucket.key(),
-                             alg=cfg0.algorithm, b=len(packed),
-                             batch_id=batch_id, device=device):
-                with guard:
-                    results = run_consensus_batch(
-                        [slab for _, _, slab, _ in packed], detect,
-                        cfg0, n_closure=bucket.n_closure, seeds=seeds)
+            try:
+                with tracer.span("serve.batch", bucket=bucket.key(),
+                                 alg=cfg0.algorithm, b=len(packed),
+                                 batch_id=batch_id, device=device):
+                    with guard:
+                        results = run_consensus_batch(
+                            [slab for _, _, slab, _ in packed], detect,
+                            cfg0, n_closure=bucket.n_closure, seeds=seeds)
+            finally:
+                # the heartbeat closes even on a failing batch — the
+                # worker is not wedged, its members retry solo
+                self._device_end(worker, head_id, bucket.key())
         except Exception as e:  # noqa: BLE001 — whole-batch failure:
             # isolate by re-running every member solo; only genuinely
             # bad jobs fail, each as itself
@@ -948,11 +1173,20 @@ class ConsensusService:
         guard = CompileGuard(registry=self._reg,
                              counter="serve.xla_compiles",
                              thread_ident=threading.get_ident())
-        with tracer.span("serve.job", bucket=bucket.key(),
-                         alg=spec.config.algorithm, device=device):
-            with guard:
-                res = run_consensus(slab, detect, spec.config, mesh=mesh,
-                                    n_closure=bucket.n_closure)
+        self._device_begin(worker,
+                           None if job is None else job.job_id,
+                           bucket.key())
+        try:
+            with tracer.span("serve.job", bucket=bucket.key(),
+                             alg=spec.config.algorithm, device=device):
+                with guard:
+                    res = run_consensus(slab, detect, spec.config,
+                                        mesh=mesh,
+                                        n_closure=bucket.n_closure)
+        finally:
+            self._device_end(worker,
+                             None if job is None else job.job_id,
+                             bucket.key())
         if job is not None:
             job.stamp("device_done")
         elapsed = time.perf_counter() - t0
@@ -973,16 +1207,20 @@ class ConsensusService:
             for j in self._jobs.values():
                 states[j.state] = states.get(j.state, 0) + 1
             buckets = dict(self._buckets)
+            last_bundle = self._last_bundle
         if self.pool is not None:
             prewarm = self.pool.prewarm_progress()
             workers = self.pool.describe()
             affinity = self.pool.scheduler.affinity()
             cordoned = [w["device"] for w in workers if w["cordoned"]]
+            suspects = self.pool.watchdog.suspects()
+            watchdog_trips = self.pool.watchdog.trips()
         else:
             prewarm = {"specs": self._prewarm_total,
                        "done": self._prewarm_done,
                        "finished": self._prewarm_finished}
             workers, affinity, cordoned = [], {}, []
+            suspects, watchdog_trips = [], 0
         return {
             "uptime_s": round(time.time() - self._started_at, 3),
             "draining": self.queue.draining(),
@@ -996,6 +1234,13 @@ class ConsensusService:
             "workers": workers,
             "affinity": affinity,
             "cordoned_devices": cordoned,
+            # fcflight: the router-facing replica self-diagnosis —
+            # which devices the watchdog currently holds suspect, how
+            # often it has tripped, and where the freshest crash
+            # evidence lives on disk
+            "suspect_devices": [t.get("device") for t in suspects],
+            "watchdog_trips": watchdog_trips,
+            "last_bundle": last_bundle,
         }
 
     def device_stats(self) -> Dict[str, Dict[str, Any]]:
@@ -1211,6 +1456,11 @@ class _Handler(BaseHTTPRequestHandler):
                              "devices": self.service.device_stats(),
                              "latency": self.service.latency_stats(),
                              "shaping": self.service.shaping_stats()})
+            return
+        if path == "/debugz/slowest":
+            # fcflight tail exemplars: the bucket-worst serve.e2e jobs
+            # joined to their flight timelines (typed in ServeClient)
+            self._send(200, self.service.slowest())
             return
         for prefix in ("/status/", "/result/"):
             if path.startswith(prefix):

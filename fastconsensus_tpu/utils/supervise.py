@@ -74,14 +74,29 @@ def run_supervised(argv: Sequence[str],
                    max_attempts: int = 10,
                    poll_seconds: float = 5.0,
                    rotate: Sequence[str] = (),
+                   flight_dir: Optional[str] = None,
+                   quit_grace_seconds: float = 10.0,
                    log=print) -> int:
     """Run ``argv`` until it exits 0, restarting on stall or failure.
 
     A *stall* is ``stall_seconds`` without the progress file's mtime (or
-    size) advancing; the child is then killed (SIGKILL — a wedged RPC
-    ignores SIGTERM) and, after ``recover_seconds`` for the transport to
-    recover, rerun.  Returns the final exit code (0 on success, the last
-    child's code otherwise).
+    size) advancing; the child is then killed and, after
+    ``recover_seconds`` for the transport to recover, rerun.  Returns
+    the final exit code (0 on success, the last child's code otherwise).
+
+    The stall kill is a two-step **SIGQUIT-then-SIGKILL** (fcflight): a
+    child running with ``--dump-on-signal`` (cli.py) or the fcserve
+    SIGQUIT handler gets ``quit_grace_seconds`` to write a post-mortem
+    bundle naming the wedged phase before the unignorable SIGKILL lands
+    — the one artifact that distinguishes "tunnel wedged" from "our
+    collective hung" after the fact.  A child without a handler dies on
+    the SIGQUIT itself (default disposition), which is the same outcome
+    one grace period sooner.  Bundles land in ``flight_dir`` (exported
+    to the child as ``FCTPU_FLIGHT_DIR``; default: ``fcflight/`` next
+    to the progress file) and each dead attempt's new bundles are
+    recorded into the first ``.jsonl`` rotate artifact as ``{"kind":
+    "flight_bundle"}`` lines, so ``obs/export.read_jsonl_chain`` reads
+    them back attempt-tagged alongside the attempt's spans.
 
     ``rotate``: files to chain-rotate (:func:`rotate_for_retry`) before
     every relaunch — point it at the child's fcobs artifacts (the
@@ -89,6 +104,14 @@ def run_supervised(argv: Sequence[str],
     telemetry survives instead of being overwritten by the next.
     """
     import signal
+
+    from fastconsensus_tpu.obs import postmortem as obs_postmortem
+
+    if flight_dir is None:
+        flight_dir = os.path.join(
+            os.path.dirname(os.path.abspath(progress_path)), "fcflight")
+    child_env = dict(os.environ)
+    child_env[obs_postmortem.ENV_DIR] = flight_dir
 
     def progress_sig() -> Optional[tuple]:
         try:
@@ -100,12 +123,42 @@ def run_supervised(argv: Sequence[str],
     def kill_tree(child) -> None:
         # the command may be a wrapper (bash, python -m ...); killing only
         # the direct child would orphan the real worker, which then keeps
-        # the device transport and output files busy across retries
+        # the device transport and output files busy across retries.
+        # SIGQUIT first: give a dump-on-signal child one grace period to
+        # write its flight bundle — the wedge is host-side, so the
+        # handler usually CAN run even when progress has stopped.
         try:
-            os.killpg(child.pid, signal.SIGKILL)
+            os.killpg(child.pid, signal.SIGQUIT)
         except (ProcessLookupError, PermissionError):
-            child.kill()
+            pass
+        deadline = time.monotonic() + max(quit_grace_seconds, 0.0)
+        while child.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.2)
+        if child.poll() is None:
+            try:
+                os.killpg(child.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                child.kill()
         child.wait()
+
+    def collect_bundles(known: set) -> List[str]:
+        """New completed bundles since ``known``, recorded into the
+        first .jsonl rotate artifact (pre-rotation, so they chain with
+        THIS attempt's segment)."""
+        fresh = [b for b in obs_postmortem.list_bundles(flight_dir)
+                 if b not in known]
+        if not fresh:
+            return []
+        sink = next((p for p in rotate if p.endswith(".jsonl")), None)
+        for b in fresh:
+            log(f"[supervise] flight bundle: {b}")
+            if sink is not None:
+                import json
+
+                with open(sink, "a", encoding="utf-8") as fh:
+                    fh.write(json.dumps(
+                        {"kind": "flight_bundle", "bundle": b}) + "\n")
+        return fresh
 
     # Fence before attempt 1: a live artifact left behind by a PREVIOUS
     # supervision of this run (supervisor killed/rebooted mid-sequence)
@@ -117,8 +170,10 @@ def run_supervised(argv: Sequence[str],
     for attempt in range(1, max_attempts + 1):
         log(f"[supervise] attempt {attempt}/{max_attempts}: "
             f"{' '.join(argv)}")
+        known_bundles = set(obs_postmortem.list_bundles(flight_dir))
         start = time.monotonic()
-        child = subprocess.Popen(list(argv), start_new_session=True)
+        child = subprocess.Popen(list(argv), start_new_session=True,
+                                 env=child_env)
         last_sig = progress_sig()
         # any observed change (including the file disappearing) refreshes
         # the stall clock; before the first change the clock runs from
@@ -150,6 +205,10 @@ def run_supervised(argv: Sequence[str],
             return 0
         log(f"[supervise] attempt {attempt} ended rc={rc}"
             f"{' (stall-killed)' if killed else ''}")
+        # harvest the dead attempt's post-mortem evidence BEFORE the
+        # rotation, so the bundle records chain inside this attempt's
+        # telemetry segment
+        collect_bundles(known_bundles)
         if attempt < max_attempts:
             rotate_for_retry(rotate, log=log)
             log(f"[supervise] waiting {recover_seconds:.0f}s before retry")
@@ -175,6 +234,15 @@ def main(args: Optional[List[str]] = None) -> int:
                         "(repeatable; point at the child's fcobs "
                         "--trace artifacts so every attempt's telemetry "
                         "chains instead of being overwritten)")
+    p.add_argument("--flight-dir", type=str, default=None, metavar="DIR",
+                   help="where the child's fcflight post-mortem bundles "
+                        "land (exported as FCTPU_FLIGHT_DIR; default: "
+                        "fcflight/ next to --progress)")
+    p.add_argument("--quit-grace-seconds", type=float, default=10.0,
+                   metavar="S",
+                   help="on stall, send SIGQUIT and wait S seconds for "
+                        "the child to dump a flight bundle before the "
+                        "SIGKILL (default 10)")
     ns, rest = p.parse_known_args(args)
     if rest and rest[0] == "--":
         rest = rest[1:]
@@ -185,7 +253,9 @@ def main(args: Optional[List[str]] = None) -> int:
                           recover_seconds=ns.recover_seconds,
                           max_attempts=ns.max_attempts,
                           poll_seconds=ns.poll_seconds,
-                          rotate=ns.rotate)
+                          rotate=ns.rotate,
+                          flight_dir=ns.flight_dir,
+                          quit_grace_seconds=ns.quit_grace_seconds)
 
 
 if __name__ == "__main__":

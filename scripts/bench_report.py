@@ -133,6 +133,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # the fcqual partition-quality gate (rounds-to-converge growth,
     # agreement drop, late-frontier growth)
     problems += history.check_quality(groups)
+    # the fcflight incident-health gate: a clean sequenced load run
+    # that trips the hang watchdog blocks, curve or no curve
+    problems += history.check_flight(groups)
     problems += history.check_footprints(footprints)
     n_recs = sum(len(r) for r in groups.values())
     if problems:

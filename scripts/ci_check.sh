@@ -995,6 +995,143 @@ if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "quality.final_agreement"; 
 fi
 echo "fcqual smoke ok: round series sane, regressed copy fails naming its rule"
 
+echo "== fcflight: incident smoke (hang watchdog, bundles, SIGQUIT dump) =="
+FLIGHT_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR" "$SERVE_DIR" "$BATCH_DIR" "$POOL_DIR" "$AUTO_DIR" "$SL_DIR" "$SHAPE_DIR" "$QUAL_DIR" "$FLIGHT_DIR"; [ -n "$SERVE_PID" ] && kill -9 "$SERVE_PID" 2>/dev/null' EXIT
+FLIGHT_PORT=$(python - <<'PYEOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+PYEOF
+)
+# The baked-in test hook (FCTPU_TEST_HANG_S) wedges the 10th device
+# dispatch for 6s inside the watchdog's device heartbeat window: nine
+# sequential warm-ups build the bucket's warm service history past the
+# default min-history guard (8 — the first dispatch is cold-tagged and
+# excluded), then the burst's first dispatch hangs.  --max-batch 1 +
+# --no-hold keep one job per dispatch so the count is exact, and the
+# high spill backlog keeps the burst sticky (a spilled dispatch would
+# be cold on the foreign device — watchdog-exempt by design).
+JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    FCTPU_TEST_HANG_S=6 FCTPU_TEST_HANG_AFTER=9 \
+    python -m fastconsensus_tpu.serve --host 127.0.0.1 \
+    --port "$FLIGHT_PORT" --queue-depth 32 --devices 2 --max-batch 1 \
+    --no-hold --spill-backlog 64 --watchdog-k 2 --watchdog-floor-s 0.5 \
+    --flight-dir "$FLIGHT_DIR" --quiet &
+SERVE_PID=$!
+JAX_PLATFORMS=cpu python - "$FLIGHT_PORT" <<'PYEOF'
+import sys
+import time
+
+from fastconsensus_tpu.serve.client import ServeClient
+from fastconsensus_tpu.utils.io import read_edgelist
+
+client = ServeClient(f"http://127.0.0.1:{int(sys.argv[1])}", timeout=30.0)
+for _ in range(150):          # wait out server startup (jax import)
+    try:
+        client.healthz()
+        break
+    except Exception:
+        time.sleep(0.2)
+else:
+    sys.exit("fcflight server never came up")
+edges, _, ids = read_edgelist("examples/karate_club.txt")
+spec = dict(edges=edges.tolist(), n_nodes=len(ids), algorithm="lpm",
+            n_p=4, delta=0.1, max_rounds=2, seed=1)
+for seed in range(1, 10):     # dispatches 0..8: warm service history
+    sub = client.submit(**dict(spec, seed=seed))
+    client.wait(sub["job_id"], timeout=300)
+h = client.healthz()
+assert h["watchdog_trips"] == 0, h   # no false trips while healthy
+burst = [client.submit(**dict(spec, seed=100 + i)) for i in range(4)]
+for sub in burst:             # the wedged job finishes LATE, not never
+    r = client.wait(sub["job_id"], timeout=300)
+    assert r["n_nodes"] == len(ids), r
+deadline = time.monotonic() + 30.0
+while time.monotonic() < deadline:
+    h = client.healthz()
+    if h["watchdog_trips"] >= 1 and h["last_bundle"]:
+        break
+    time.sleep(0.2)
+assert h["watchdog_trips"] >= 1, h
+assert h["last_bundle"], h
+m = client.metricsz()
+c = m["fcobs"]["counters"]
+assert c.get("serve.flight.watchdog_trips", 0) >= 1, c
+assert c.get("serve.pool.worker_cordons", 0) >= 1, c
+assert c.get("serve.flight.bundles", 0) >= 1, c
+slow = client.slowest()       # the typed tail-exemplar surface
+assert slow and slow[0].e2e_s > 0.0, slow
+print(f"fcflight hang smoke ok: {h['watchdog_trips']} trip(s), "
+      f"burst of {len(burst)} completed, bundle {h['last_bundle']}")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcflight hang-injection smoke failed (exit $rc)" >&2
+    exit $rc
+fi
+# SIGQUIT = "dump a bundle and KEEP serving" (SIGTERM is the drain)
+kill -QUIT "$SERVE_PID"
+JAX_PLATFORMS=cpu python - "$FLIGHT_PORT" <<'PYEOF'
+import sys
+import time
+
+from fastconsensus_tpu.serve.client import ServeClient
+
+client = ServeClient(f"http://127.0.0.1:{int(sys.argv[1])}", timeout=30.0)
+deadline = time.monotonic() + 15.0
+h = {}
+while time.monotonic() < deadline:
+    h = client.healthz()      # still answering: the process lived
+    if "sigquit" in (h.get("last_bundle") or ""):
+        break
+    time.sleep(0.2)
+assert "sigquit" in (h.get("last_bundle") or ""), h
+assert h["ok"] and not h["draining"], h
+print("fcflight SIGQUIT dump ok: bundle written, server kept serving")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcflight SIGQUIT dump smoke failed (exit $rc)" >&2
+    exit $rc
+fi
+kill -TERM "$SERVE_PID"
+wait "$SERVE_PID"
+rc=$?
+SERVE_PID=""
+if [ $rc -ne 0 ]; then
+    echo "fcflight server did not drain cleanly on SIGTERM (exit $rc)" >&2
+    exit $rc
+fi
+# the jax-free reader over what the incident left behind: render must
+# name the wedged device's trip, diff must compare two dumps
+WD_BUNDLE=$(ls -d "$FLIGHT_DIR"/fcflight_*_watchdog_* 2>/dev/null | head -1)
+SQ_BUNDLE=$(ls -d "$FLIGHT_DIR"/fcflight_*_sigquit 2>/dev/null | head -1)
+if [ -z "$WD_BUNDLE" ] || [ -z "$SQ_BUNDLE" ]; then
+    echo "missing watchdog/sigquit bundle under $FLIGHT_DIR:" >&2
+    ls "$FLIGHT_DIR" >&2
+    exit 1
+fi
+out=$(python -m fastconsensus_tpu.obs.postmortem render "$WD_BUNDLE")
+rc=$?
+if [ $rc -ne 0 ] || ! printf '%s' "$out" | grep -q "watchdog_trip"; then
+    echo "postmortem render did not parse the watchdog bundle" \
+         "(exit $rc):" >&2
+    echo "$out" >&2
+    exit 1
+fi
+out=$(python -m fastconsensus_tpu.obs.postmortem diff \
+    "$WD_BUNDLE" "$SQ_BUNDLE")
+rc=$?
+if [ $rc -ne 0 ] || ! printf '%s' "$out" | grep -q "flight events by kind"; then
+    echo "postmortem diff failed between the two bundles (exit $rc):" >&2
+    echo "$out" >&2
+    exit 1
+fi
+echo "fcflight smoke ok: cordon-on-stall, SIGQUIT dump, reader round-trip"
+
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"
     exit 0

@@ -49,6 +49,13 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 # FCTPU_CALIBRATE_DIR to a tmp dir and re-enable explicitly.
 os.environ["FCTPU_CALIBRATE"] = "0"
 
+# Flight bundles out of the repo: incident dumps default to ./fcflight
+# (obs/postmortem.py), so a worker-death or watchdog test run from the
+# checkout would litter the tree.  Tests that assert on bundle paths
+# pass ServeConfig(flight_dir=tmp_path) and override this anyway.
+os.environ.setdefault(
+    "FCTPU_FLIGHT_DIR", f"/tmp/fctpu_flight_{_host_tag()}_{os.getpid()}")
+
 # The TPU-tunnel plugin registers itself from sitecustomize at interpreter
 # start (before this file runs) and hijacks backend selection even under
 # JAX_PLATFORMS=cpu; drop its factory so the suite can never touch (or hang

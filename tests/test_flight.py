@@ -1,0 +1,592 @@
+"""fcflight: incident observability — the always-on flight recorder
+(bounded per-thread event rings), the hang watchdog with its
+cordon-on-stall path, post-mortem bundles with the jax-free reader, and
+the tail-latency exemplar surface (``/debugz/slowest``).
+
+Everything above the "end to end" section is jax-free and fake-clocked:
+the recorder, the watchdog verdict and the bundle reader are stdlib
+modules by construction, so their units run without touching a device.
+The e2e tests reuse the suite's forced 8-device virtual CPU mesh
+(conftest.py) and the test hang hook (``FCTPU_TEST_HANG_S``) the server
+bakes in for exactly this purpose.
+"""
+
+import itertools
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def _ring(n, chords=0, shift=7):
+    idx = np.arange(n)
+    edges = [np.stack([idx, (idx + 1) % n], 1)]
+    if chords:
+        c = np.arange(chords)
+        edges.append(np.stack([c % n, (c + shift) % n], 1))
+    return np.concatenate(edges).astype(np.int64)
+
+
+def _spec(edges, n_nodes, **over):
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.jobs import JobSpec
+
+    kwargs = dict(algorithm="louvain", n_p=4, tau=0.2, delta=0.02,
+                  max_rounds=2, seed=0)
+    kwargs.update(over)
+    return JobSpec(edges=np.asarray(edges, dtype=np.int64),
+                   n_nodes=n_nodes, config=ConsensusConfig(**kwargs))
+
+
+def _wait(jobs, timeout=180.0):
+    deadline = time.monotonic() + timeout
+    for j in jobs:
+        while j.state not in ("done", "failed"):
+            assert time.monotonic() < deadline, j.describe()
+            time.sleep(0.02)
+
+
+# -- the flight recorder (unit, jax-free) ------------------------------
+
+
+def test_ring_bound_and_drop_accounting():
+    """A ring retains exactly ``capacity`` events, oldest-overwrite,
+    and reports how many it dropped — the hard memory cap."""
+    from fastconsensus_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=8, max_rings=4)
+    for i in range(100):
+        rec.record("unit", job=f"j{i}", i=i)
+    snap = rec.snapshot()
+    assert snap["capacity"] == 8 and snap["max_rings"] == 4
+    assert len(snap["rings"]) == 1
+    ring = snap["rings"][0]
+    assert ring["dropped"] == 92 and snap["dropped"] == 92
+    assert snap["n_events"] == 8
+    assert [e["i"] for e in ring["events"]] == list(range(92, 100))
+    for e in ring["events"]:
+        assert e["kind"] == "unit" and e["ts"] > 0.0
+        assert e["job"] == f"j{e['i']}"
+
+
+def test_concurrent_writers_keep_ring_integrity():
+    """N writer threads, each with its own ring; snapshots taken WHILE
+    they write must always see each ring as a consistent window —
+    well-formed events, per-writer sequence numbers strictly
+    increasing, never more than ``capacity`` retained."""
+    from fastconsensus_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=64, max_rings=8)
+    n_threads, n_events = 6, 500
+    start = threading.Event()
+
+    def writer(k):
+        start.wait()
+        for i in range(n_events):
+            rec.record("w", job=f"t{k}", i=i)
+
+    threads = [threading.Thread(target=writer, args=(k,),
+                                name=f"fl-writer-{k}")
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    start.set()
+    for _ in range(50):    # racing snapshots: the atomicity contract
+        for ring in rec.snapshot()["rings"]:
+            assert len(ring["events"]) <= 64
+            seqs = [e["i"] for e in ring["events"]]
+            assert seqs == sorted(seqs)
+            assert len(set(seqs)) == len(seqs)
+            for e in ring["events"]:
+                assert e["kind"] == "w" and "ts" in e and "job" in e
+    for t in threads:
+        t.join()
+    snap = rec.snapshot()
+    assert len(snap["rings"]) == n_threads
+    for ring in snap["rings"]:
+        assert len(ring["events"]) == 64
+        assert ring["dropped"] == n_events - 64
+        assert [e["i"] for e in ring["events"]] == \
+            list(range(n_events - 64, n_events))
+
+
+def test_thread_storm_shares_one_overflow_ring():
+    """Threads past ``max_rings`` share one ring: the memory cap holds
+    in a thread storm, and no event is silently unrecorded."""
+    from fastconsensus_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=256, max_rings=2)
+
+    def writer(k):
+        for i in range(10):
+            rec.record("storm", k=k, i=i)
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = rec.snapshot()
+    assert len(snap["rings"]) <= 3    # max_rings + the shared overflow
+    assert any(r["thread"] == "<overflow>" for r in snap["rings"])
+    assert snap["n_events"] == 50     # all retained (under capacity)
+
+
+def test_merge_events_filters_and_limit():
+    from fastconsensus_tpu.obs.flight import FlightRecorder
+
+    rec = FlightRecorder(capacity=128, max_rings=4)
+    for i in range(10):
+        rec.record("admit" if i % 2 else "pop",
+                   job=f"j{i % 3}", i=i)
+    tl = rec.events()
+    assert [e["i"] for e in tl] == list(range(10))    # ts-sorted
+    assert all(e["thread"] for e in tl)
+    only_j0 = rec.events(job="j0")
+    assert {e["job"] for e in only_j0} == {"j0"}
+    admits = rec.events(kinds=("admit",))
+    assert {e["kind"] for e in admits} == {"admit"}
+    last3 = rec.events(limit=3)
+    assert [e["i"] for e in last3] == [7, 8, 9]    # most recent kept
+
+
+# -- the hang watchdog (unit, fake clock) ------------------------------
+
+
+class _StubLatency:
+    """service_estimate stub: fixed estimate, or None (no history)."""
+
+    def __init__(self, est):
+        self.est = est
+
+    def service_estimate(self, bucket=None, min_count=1):
+        return self.est
+
+
+def _wd(est, trips=None, **cfg_over):
+    from fastconsensus_tpu.serve.watchdog import (HangWatchdog,
+                                                  WatchdogConfig)
+
+    now = [0.0]
+    cfg = dict(k=2.0, floor_s=1.0, min_history=8, poll_s=0.5)
+    cfg.update(cfg_over)
+    wd = HangWatchdog(_StubLatency(est), WatchdogConfig(**cfg),
+                      clock=lambda: now[0], on_trip=trips)
+    return wd, now
+
+
+def test_watchdog_trips_once_per_episode_and_clears_on_beat():
+    est = {"count": 20, "mean_s": 0.05, "p95_s": 0.1}
+    wd, now = _wd(est)
+    wd.beat(0, "device", job="j1", bucket="n64_e96")
+    assert wd.check(now=0.5) == []            # under the floor
+    trips = wd.check(now=1.5)                 # threshold = max(.2, 1.0)
+    assert len(trips) == 1
+    t = trips[0]
+    assert t["device"] == 0 and t["job"] == "j1"
+    assert t["bucket"] == "n64_e96"
+    assert t["threshold_s"] == 1.0 and t["elapsed_s"] == 1.5
+    assert t["history"] == 20
+    assert wd.check(now=50.0) == []           # one trip per episode
+    assert wd.trips() == 1
+    assert [s["device"] for s in wd.suspects()] == [0]
+    wd.beat(0, "device_done")                 # the call returned late
+    assert wd.suspects() == []
+    now[0] = 100.0
+    wd.beat(0, "device", job="j2", bucket="n64_e96")
+    assert len(wd.check(now=200.0)) == 1      # a NEW episode re-trips
+    assert wd.trips() == 2
+    d = wd.describe()
+    assert d["trips"] == 2 and d["beats"][0]["tripped"]
+
+
+def test_watchdog_cold_and_min_history_guards():
+    """The two structural false-positive guards: a dispatch expected to
+    compile never trips, and a bucket with no trusted distribution
+    never trips — and non-device states are never candidates."""
+    est = {"count": 20, "mean_s": 0.05, "p95_s": 0.1}
+    wd, _ = _wd(est)
+    wd.beat(0, "device", job="cold", bucket="b", cold=True)
+    assert wd.check(now=1e6) == []            # XLA may take minutes
+    wd.beat(1, "dequeue", job="q")
+    wd.beat(2, "idle")
+    assert wd.check(now=1e6) == []            # only device windows trip
+    wd_none, _ = _wd(None)                    # no history at all
+    wd_none.beat(0, "device", job="j", bucket="b")
+    assert wd_none.check(now=1e6) == []
+    assert wd_none.trips() == 0
+
+
+def test_watchdog_no_false_trip_below_threshold():
+    est = {"count": 50, "mean_s": 0.5, "p95_s": 1.0}
+    wd, _ = _wd(est, k=8.0, floor_s=0.5)      # threshold = 8 x p95
+    wd.beat(3, "device", job="slowish", bucket="b")
+    assert wd.check(now=7.9) == []
+    assert wd.suspects() == []
+    assert len(wd.check(now=8.1)) == 1
+
+
+def test_watchdog_config_validation_and_disabled_singleton():
+    from fastconsensus_tpu.serve.watchdog import (DISABLED_WATCHDOG,
+                                                  WatchdogConfig)
+
+    for bad in (dict(k=0.0), dict(floor_s=-1.0), dict(min_history=0),
+                dict(poll_s=0.0)):
+        with pytest.raises(ValueError):
+            WatchdogConfig(**bad).validate()
+    DISABLED_WATCHDOG.beat(0, "device", job="j")
+    assert DISABLED_WATCHDOG.check(now=1e9) == []
+    assert DISABLED_WATCHDOG.suspects() == []
+    assert DISABLED_WATCHDOG.trips() == 0
+    assert DISABLED_WATCHDOG.describe()["config"]["enabled"] is False
+    DISABLED_WATCHDOG.start()
+    DISABLED_WATCHDOG.stop()
+
+
+def test_watchdog_poll_thread_delivers_trips_and_survives_bad_handler():
+    """The real poll thread: delivers each trip to ``on_trip`` exactly
+    once, and a throwing handler does not kill the watchdog."""
+    est = {"count": 20, "mean_s": 0.05, "p95_s": 0.1}
+    got = []
+    seen = threading.Event()
+
+    def on_trip(trip):
+        got.append(trip)
+        seen.set()
+        raise RuntimeError("handler bug (must not kill the thread)")
+
+    wd, now = _wd(est, trips=on_trip, poll_s=0.01)
+    wd.beat(0, "device", job="j1", bucket="b")
+    wd.start()
+    try:
+        now[0] = 10.0                         # wedge, by fake clock
+        assert seen.wait(5.0)
+        time.sleep(0.05)                      # a few more polls
+        assert len(got) == 1                  # once per episode
+        wd.beat(0, "device_done")
+        seen.clear()
+        now[0] = 20.0
+        wd.beat(0, "device", job="j2", bucket="b")
+        now[0] = 40.0                         # second episode, after a
+        assert seen.wait(5.0)                 # handler that raised
+        assert [t["job"] for t in got] == ["j1", "j2"]
+    finally:
+        wd.stop()
+
+
+# -- post-mortem bundles (jax-free round-trip) -------------------------
+
+
+def test_bundle_write_schema_and_listing(tmp_path):
+    """One ``write_bundle`` call produces a complete, self-contained
+    directory: auto sections + caller sections + thread stacks, with
+    the MANIFEST (written last) indexing exactly what landed — and an
+    unserializable payload degrades to its repr instead of throwing."""
+    from fastconsensus_tpu.obs import flight as obs_flight
+    from fastconsensus_tpu.obs import postmortem
+
+    base = str(tmp_path)
+    obs_flight.record("unit_marker", job="jB", note="bundle-test")
+    before = postmortem.bundles_written()
+    path = postmortem.write_bundle(
+        "unit_test",
+        sections={"jobs": {"jobs": [{"job_id": "jB", "state": "running",
+                                     "bucket": "n64_e96",
+                                     "phases_s": {"device": 1.5}}]},
+                  "weird": {"obj": object()}},    # repr, not a raise
+        base_dir=base)
+    assert postmortem.bundles_written() == before + 1
+    assert os.path.basename(path).startswith("fcflight_")
+    with open(os.path.join(path, "MANIFEST.json")) as fh:
+        manifest = json.load(fh)
+    assert manifest["schema"] == 1 and manifest["reason"] == "unit_test"
+    assert manifest["pid"] == os.getpid()
+    for section in ("flight.json", "counters.json", "latency.json",
+                    "stacks.txt", "jobs.json", "weird.json"):
+        assert section in manifest["sections"]
+        assert os.path.exists(os.path.join(path, section))
+    with open(os.path.join(path, "flight.json")) as fh:
+        flight = json.load(fh)
+    assert any(e.get("kind") == "unit_marker"
+               for r in flight["rings"] for e in r["events"])
+    with open(os.path.join(path, "weird.json")) as fh:
+        assert "object object" in json.load(fh)["obj"]
+    # listing: manifest presence defines completeness
+    os.makedirs(os.path.join(base, "fcflight_partial_no_manifest"))
+    os.makedirs(os.path.join(base, "unrelated_dir"))
+    assert postmortem.list_bundles(base) == [path]
+    assert postmortem.list_bundles(str(tmp_path / "missing")) == []
+
+
+def test_bundle_render_and_diff(tmp_path):
+    """The reader round-trip: ``render`` names the in-flight job with
+    its phase timeline, shows the flight tail and the thread stacks;
+    ``diff`` reports counter deltas between two dumps."""
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.obs import flight as obs_flight
+    from fastconsensus_tpu.obs import postmortem
+
+    base = str(tmp_path)
+    jobs = {"jobs": [{"job_id": "j-wedged", "state": "running",
+                      "bucket": "n64_e96",
+                      "phases_s": {"queue": 0.002, "device": 312.4}},
+                     {"job_id": "j-done", "state": "done",
+                      "bucket": "n64_e96",
+                      "phases_s": {"device": 0.04}}]}
+    obs_flight.record("device", job="j-wedged", device=3)
+    old = postmortem.write_bundle("first", {"jobs": jobs},
+                                  base_dir=base)
+    obs_counters.get_registry().inc("serve.flight.watchdog_trips")
+    obs_flight.record("watchdog_trip", job="j-wedged", device=3)
+    new = postmortem.write_bundle(
+        "watchdog_d3",
+        {"jobs": jobs, "watchdog": {"trips": 1},
+         "config": {"queue_depth": 8}},
+        base_dir=base)
+    text = postmortem.render(new)
+    assert "reason   : watchdog_d3" in text
+    assert "j-wedged state=running bucket=n64_e96" in text
+    assert "device=312400.0ms" in text        # the open device phase
+    assert "watchdog_trip job=j-wedged" in text
+    assert "thread stacks (faulthandler)" in text
+    assert "serve.flight.watchdog_trips" in text
+    delta = postmortem.diff(old, new)
+    assert "serve.flight.watchdog_trips" in delta
+    assert "watchdog_trip: 0 -> 1" in delta
+    # an incomplete dir renders a refusal, not a crash
+    assert "not a complete bundle" in postmortem.render(str(tmp_path))
+
+
+def test_postmortem_reader_is_jax_free(tmp_path):
+    """The incident reader must work on the box where jax is exactly
+    what is broken: render a real bundle in a subprocess with jax
+    POISONED in sys.modules."""
+    from fastconsensus_tpu.obs import postmortem
+
+    path = postmortem.write_bundle(
+        "poison_test",
+        {"jobs": {"jobs": [{"job_id": "jP", "state": "running",
+                            "bucket": "b", "phases_s": {"device": 9.0}}]}},
+        base_dir=str(tmp_path))
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "from fastconsensus_tpu.obs import postmortem\n"
+        f"sys.exit(postmortem.main(['render', {path!r}]))\n")
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, PYTHONPATH=os.path.abspath(root))
+    res = subprocess.run([sys.executable, "-c", code], cwd=root, env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "jP state=running" in res.stdout
+    assert "reason   : poison_test" in res.stdout
+
+
+# -- tail exemplars (unit, jax-free) -----------------------------------
+
+
+def test_histogram_exemplar_slots_are_bounded_largest_win():
+    from fastconsensus_tpu.obs.latency import (EXEMPLAR_SLOTS,
+                                               LatencyHistogram,
+                                               merge_snapshots)
+
+    h = LatencyHistogram()
+    h.record(0.010)                           # no exemplar attached
+    assert "exemplars" not in h.snapshot()    # byte-identical contract
+    h.record(0.0101, exemplar="jA")           # same log2 bucket:
+    h.record(0.0103, exemplar="jB")           # only the largest
+    h.record(0.0102, exemplar="jC")           # EXEMPLAR_SLOTS survive
+    h.record(5.0, exemplar="jSlow")           # a different bucket
+    snap = h.snapshot()
+    slots = snap["exemplars"]
+    per_bucket = {tuple(e for e, _ in v) for v in slots.values()}
+    assert ("jSlow",) in per_bucket
+    assert ("jB", "jC") in per_bucket         # largest two, desc
+    assert all(len(v) <= EXEMPLAR_SLOTS for v in slots.values())
+    merged = merge_snapshots([snap, snap])    # exact-merge keeps bound
+    assert all(len(v) <= EXEMPLAR_SLOTS
+               for v in merged["exemplars"].values())
+    assert merged["count"] == 2 * snap["count"]
+
+
+def test_slow_exemplar_typed_parse_is_jax_free():
+    """``ServeClient.slowest()``'s typed row must parse on a thin
+    client: poisoned-jax subprocess builds a SlowJobExemplar from a
+    canned ``/debugz/slowest`` payload."""
+    payload = {"job_id": "j9", "e2e_s": 1.25, "bucket": "n64_e96",
+               "rung": "1", "priority": "0", "device": "3",
+               "events": [{"ts": 1.0, "kind": "admit", "job": "j9"},
+                          {"ts": 2.0, "kind": "finish", "job": "j9"}],
+               "timing": None}
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "from fastconsensus_tpu.serve.client import SlowJobExemplar\n"
+        f"r = SlowJobExemplar.from_payload({payload!r})\n"
+        "assert r.job_id == 'j9' and r.e2e_s == 1.25\n"
+        "assert r.bucket == 'n64_e96' and r.device == '3'\n"
+        "assert [e['kind'] for e in r.events] == ['admit', 'finish']\n"
+        "assert r.timing is None\n"
+        "print('typed parse ok')\n")
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, PYTHONPATH=os.path.abspath(root))
+    res = subprocess.run([sys.executable, "-c", code], cwd=root, env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "typed parse ok" in res.stdout
+
+
+# -- end to end (the virtual 8-device CPU mesh) ------------------------
+
+
+def test_slowest_endpoint_joins_exemplars_to_flight_timelines(
+        karate_edges):
+    """Submit real jobs over HTTP, then ask ``/debugz/slowest``: the
+    worst ``serve.e2e`` exemplars come back typed, slowest first, each
+    joined to its retained flight-recorder timeline."""
+    from fastconsensus_tpu.serve.client import ServeClient
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig,
+                                                make_http_server)
+
+    edges, _, ids = karate_edges
+    svc = ConsensusService(ServeConfig(queue_depth=8, pin_sizing=False))
+    httpd = make_http_server(svc, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    svc.start()
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout=30.0)
+    try:
+        payload = dict(edges=edges.tolist(), n_nodes=len(ids),
+                       algorithm="louvain", n_p=4, delta=0.02,
+                       max_rounds=2, seed=1)
+        sub = [client.submit(**dict(payload, seed=s))
+               for s in (1, 2)]
+        done = [client.wait(s["job_id"], timeout=120) for s in sub]
+        assert all(len(r["partitions"]) == 4 for r in done)
+        rows = client.slowest()
+        assert rows, "no serve.e2e exemplars after two finished jobs"
+        assert [r.e2e_s for r in rows] == \
+            sorted((r.e2e_s for r in rows), reverse=True)
+        ids_seen = {r.job_id for r in rows}
+        assert ids_seen & {s["job_id"] for s in sub}
+        top = rows[0]
+        assert top.e2e_s > 0.0 and isinstance(top.events, tuple)
+        kinds = {e["kind"] for e in top.events}
+        assert {"admit", "finish"} & kinds    # timeline joined by job
+        # the incident fields ride /healthz for the fleet scraper
+        h = client.healthz()
+        assert h["suspect_devices"] == [] and h["watchdog_trips"] == 0
+        assert h["last_bundle"] is None
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        assert svc.drain(60)
+
+
+def test_cordon_on_stall_end_to_end(tmp_path, monkeypatch):
+    """ISSUE 13 acceptance: a device call wedged via the baked-in test
+    hook (``FCTPU_TEST_HANG_S``) trips the hang watchdog, writes a
+    post-mortem bundle, and cordons the stuck worker through the PR 6
+    machinery — while the rest of the burst still completes.  The
+    wedged call then returns late: its job finishes, the worker stays
+    cordoned."""
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.obs import postmortem
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+    from fastconsensus_tpu.serve.watchdog import WatchdogConfig
+
+    monkeypatch.setenv("FCTPU_TEST_HANG_S", "2.5")
+    monkeypatch.setenv("FCTPU_TEST_HANG_AFTER", "0")
+    svc = ConsensusService(ServeConfig(
+        queue_depth=32, pin_sizing=False, devices=2,
+        flight_dir=str(tmp_path),
+        watchdog=WatchdogConfig(k=2.0, floor_s=0.4, min_history=1,
+                                poll_s=0.05, cordon=True)))
+    svc._hang_s = 0.0                 # hold the hook while warming up
+    svc.start()
+    base = obs_counters.get_registry().counters()
+    try:
+        # warm up SEQUENTIALLY: coalesced submissions would ride the
+        # first (cold) device call and leave no warm service history
+        # for the estimator the watchdog thresholds against
+        warm = []
+        for s in range(1, 4):
+            j = svc.submit(_spec(_ring(40, chords=40), 40, seed=s))
+            _wait([j])
+            warm.append(j)
+        assert all(j.state == "done" for j in warm), \
+            [j.error for j in warm]
+        # arm the hook: the very next device dispatch sleeps 2.5s
+        # inside the watchdog's device heartbeat window
+        svc._hang_s = 2.5
+        svc._hang_seq = itertools.count()
+        burst = [svc.submit(_spec(_ring(40, chords=40), 40, seed=s))
+                 for s in range(10, 14)]
+        _wait(burst)
+        assert all(j.state == "done" for j in burst), \
+            [j.error for j in burst]
+        since = obs_counters.get_registry().counters_since(base)
+        assert since.get("serve.flight.watchdog_trips", 0) >= 1, since
+        assert since.get("serve.pool.worker_cordons", 0) >= 1, since
+        assert since.get("serve.flight.bundles", 0) >= 1, since
+        stats = svc.stats()
+        assert stats["watchdog_trips"] >= 1
+        assert stats["cordoned_devices"], stats
+        assert stats["last_bundle"] and \
+            stats["last_bundle"].startswith(str(tmp_path))
+        bundles = postmortem.list_bundles(str(tmp_path))
+        assert bundles                # complete (manifest present)
+        assert "watchdog" in os.path.basename(bundles[-1])
+        text = postmortem.render(bundles[-1])
+        assert "reason   : watchdog" in text
+        assert "watchdog_trip" in text
+    finally:
+        assert svc.drain(90)
+
+
+def test_flight_surfaces_add_zero_compiles_and_zero_host_syncs(
+        karate_edges):
+    """The overhead pin: with the server warm, a same-bucket request
+    through the fully instrumented path still compiles nothing, and
+    the fcflight surfaces themselves (record / snapshot / watchdog
+    beats / slowest) perform zero deliberate host syncs."""
+    from fastconsensus_tpu.analysis import assert_max_compiles
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.obs import flight as obs_flight
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+    from fastconsensus_tpu.serve.watchdog import (HangWatchdog,
+                                                  WatchdogConfig)
+
+    edges, _, ids = karate_edges
+    svc = ConsensusService(ServeConfig(queue_depth=4, pin_sizing=False))
+    r1 = svc.run_spec(_spec(edges, len(ids)))
+    assert not r1["cached"]
+    with assert_max_compiles(0):      # warm bucket: instrumentation
+        r2 = svc.run_spec(_spec(_ring(40, chords=40), 40))  # adds none
+    assert r2["bucket"] == r1["bucket"]
+    base = obs_counters.get_registry().counters()
+    rec = obs_flight.get_flight_recorder()
+    wd = HangWatchdog(_StubLatency({"count": 9, "p95_s": 0.1,
+                                    "mean_s": 0.05}),
+                      WatchdogConfig(poll_s=0.5), clock=lambda: 0.0)
+    with assert_max_compiles(0):
+        for i in range(2000):
+            rec.record("pin", job=f"j{i % 7}", i=i)
+        rec.snapshot()
+        rec.events(job="j0", limit=16)
+        for i in range(100):
+            wd.beat(0, "device", job="j", bucket="b")
+            wd.check(now=0.0)
+            wd.beat(0, "device_done")
+        svc.slowest()
+    since = obs_counters.get_registry().counters_since(base)
+    assert since.get("host_sync.total", 0) == 0, since

@@ -277,12 +277,19 @@ def test_busy_worker_recoalesces_deque_burst_into_one_batch():
         stall = svc.submit(_spec(_ring(40, chords=40), 40, n_p=8,
                                  seed=90))
         assert entered.wait(60), "worker never picked up the stall job"
-        burst = [svc.submit(_spec(_ring(40, chords=40), 40, seed=s))
-                 for s in (91, 92, 93, 94)]
-        deadline = time.monotonic() + 30
-        while svc.pool.backlog() < 4:   # dispatch is asynchronous
-            assert time.monotonic() < deadline
-            time.sleep(0.01)
+        # park each burst job in the worker's deque before submitting
+        # the next: pop_batch coalesces same-group ride-alongs straight
+        # off the admission heap whenever MORE than one is queued (hold
+        # or no hold), and a burst coalesced upstream would leave the
+        # deque re-merge — the layer under test — nothing to do
+        burst = []
+        for k, s in enumerate((91, 92, 93, 94), start=1):
+            burst.append(svc.submit(_spec(_ring(40, chords=40), 40,
+                                          seed=s)))
+            deadline = time.monotonic() + 30
+            while svc.pool.backlog() < k:   # dispatch is asynchronous
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
     finally:
         release.set()
     try:
@@ -296,6 +303,8 @@ def test_busy_worker_recoalesces_deque_burst_into_one_batch():
         assert all(j.batch_size == 4 for j in burst)
         since = obs_counters.get_registry().counters_since(base)
         assert since.get("serve.pool.deque_coalesced", 0) == 3, since
+        # every merge happened at the deque, none at the heap
+        assert since.get("serve.queue.coalesced_pops", 0) == 0, since
     finally:
         assert svc.drain(120)
 
