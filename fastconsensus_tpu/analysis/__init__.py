@@ -1,7 +1,8 @@
 """fcheck: the project's static-analysis suite (AST lint + concurrency
-pass + jaxpr audit + footprint model + runtime guards).
+pass + jaxpr audit + footprint model + name contracts + runtime
+guards).
 
-Five layers, one report (run ``python -m fastconsensus_tpu.analysis``):
+Six layers, one report (run ``python -m fastconsensus_tpu.analysis``):
 
 1. **AST lint** (analysis/astlint.py) — project-specific source rules:
    PRNG key reuse, Python control flow on traced values, retrace
@@ -23,7 +24,18 @@ Five layers, one report (run ``python -m fastconsensus_tpu.analysis``):
    is budgeted (``padding-waste``), and ``derive_chip_ceiling`` feeds
    the model back into serving (``serve --chip-max-edges auto`` and
    startup ``--warm`` validation).
-5. **Runtime guards** — :class:`CompileGuard`
+5. **Name contracts** (analysis/contracts.py) — the whole-program
+   string-contract pass over the serving/observability surface:
+   constant-propagated writer templates for every fcobs
+   counter/gauge/series/histogram tag and flight event, the wire-key
+   universe every HTTP endpoint emits, and the reader inventories
+   (obs/history.py gates, scripts/bench_report.py,
+   scripts/ci_check.sh greps, the typed client, the README tables) —
+   ``phantom-reader``, ``schema-drift``, ``dead-counter``,
+   ``event-vocab``, ``doc-drift``.  Jax-free; the committed
+   ``runs/contract_r14.json`` inventory feeds a live ``/metricsz``
+   cross-check (``contracts.assert_covered``).
+6. **Runtime guards** — :class:`CompileGuard`
    (analysis/recompile_guard.py) bounds XLA compilations over a region
    (the tier-1 compile-budget pins), and the opt-in lock-order recorder
    (analysis/lockorder.py, ``FCTPU_LOCK_ORDER=1``) logs the observed
@@ -62,21 +74,24 @@ def lint_paths(paths, report=None):
     """Lint every ``.py`` under ``paths`` (files or directories) into a
     Report (created if not given).
 
-    Three passes: the first summarizes every function's PRNG-key
+    Four passes: the first summarizes every function's PRNG-key
     consumption (astlint.summarize_key_params), the second lints with
     that table in hand — so the ``key-reuse`` rule tracks keys through
     helper calls across module boundaries (e.g. ``seg.pair_jitter``)
-    instead of treating every callee as an opaque single draw — and the
+    instead of treating every callee as an opaque single draw — the
     third runs the whole-program concurrency analysis
     (analysis/concurrency.py: guarded-field, lock-order,
     blocking-under-lock, notify-outside-lock, unguarded-root-write)
-    over the same source set.
+    over the same source set, and the fourth the name-contract pass
+    (analysis/contracts.py: repo mode when the scan covers the
+    serving/obs surface, fixture mode for CONTRACT_SPEC files).
     """
     import os
 
     from fastconsensus_tpu.analysis.astlint import (lint_source,
                                                     summarize_key_params)
     from fastconsensus_tpu.analysis.concurrency import check_concurrency
+    from fastconsensus_tpu.analysis.contracts import check_contracts
 
     if report is None:
         report = Report()
@@ -111,4 +126,7 @@ def lint_paths(paths, report=None):
     conc_diags, conc_suppressed = check_concurrency(sources)
     report.extend(conc_diags)
     report.n_suppressed += conc_suppressed
+    con_diags, con_suppressed = check_contracts(sources)
+    report.extend(con_diags)
+    report.n_suppressed += con_suppressed
     return report

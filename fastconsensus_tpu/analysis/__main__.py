@@ -90,6 +90,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "bench-history artifact (runs/"
                              "footprint_rNN.json; scripts/bench_report."
                              "py renders and gates it)")
+    parser.add_argument("--emit-inventory", metavar="PATH", default=None,
+                        help="write the fcheck-contract writer/reader "
+                             "inventory artifact (runs/contract_rNN."
+                             "json) — the static half of the runtime "
+                             "/metricsz cross-check and the source of "
+                             "the README counters appendix; needs a "
+                             "package scan")
+    parser.add_argument("--emit-appendix", action="store_true",
+                        help="with --emit-inventory (or on a package "
+                             "scan): print the README 'Counters & "
+                             "series reference' body to stdout and "
+                             "exit (scripts/ci_check.sh diffs it "
+                             "against the committed README)")
     parser.add_argument("--quiet", action="store_true",
                         help="suppress per-diagnostic output")
     args = parser.parse_args(argv)
@@ -105,16 +118,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"fcheck: cannot read {e.filename or e}: {e.strerror or e}",
               file=sys.stderr)
         return 2
+    except ValueError as e:
+        # a malformed fixture posture (CONTRACT_SPEC / FOOTPRINT_SPEC)
+        # must fail loudly, not lint as an empty universe
+        print(f"fcheck: {e}", file=sys.stderr)
+        return 2
 
     only = None
     if args.only:
         from fastconsensus_tpu.analysis.astlint import ASTLINT_RULES
         from fastconsensus_tpu.analysis.concurrency import \
             CONCURRENCY_RULES
+        from fastconsensus_tpu.analysis.contracts import CONTRACT_RULES
         from fastconsensus_tpu.analysis.footprint import FOOTPRINT_RULES
 
         known = set(ASTLINT_RULES) | set(CONCURRENCY_RULES) | \
-            set(FOOTPRINT_RULES) | {
+            set(FOOTPRINT_RULES) | set(CONTRACT_RULES) | {
             "jaxpr-f64", "jaxpr-device-put", "jaxpr-gather-size",
             "trace-error"}
         only = {r.strip() for r in args.only.split(",") if r.strip()}
@@ -227,6 +246,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         with open(args.footprint_out, "w", encoding="utf-8") as fh:
             _json.dump(report.footprint, fh, indent=2, sort_keys=True)
             fh.write("\n")
+
+    if args.emit_inventory or args.emit_appendix:
+        import json as _json
+
+        from fastconsensus_tpu.analysis import contracts as conmod
+
+        try:
+            inventory = conmod.inventory_from_paths(paths)
+        except (ValueError, OSError) as e:
+            print(f"fcheck: {e}", file=sys.stderr)
+            return 2
+        if args.emit_inventory:
+            out_dir = os.path.dirname(
+                os.path.abspath(args.emit_inventory))
+            os.makedirs(out_dir, exist_ok=True)
+            with open(args.emit_inventory, "w", encoding="utf-8") as fh:
+                _json.dump(inventory, fh, indent=2, sort_keys=True)
+                fh.write("\n")
+        if args.emit_appendix:
+            # generator mode, not a gate: the drift check diffs this
+            # output against the committed README section
+            print(conmod.render_counters_appendix(inventory))
+            return 0
 
     if args.json:
         os.makedirs(os.path.dirname(os.path.abspath(args.json)),

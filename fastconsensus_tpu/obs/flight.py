@@ -67,6 +67,18 @@ from typing import Any, Dict, List, Optional
 DEFAULT_CAPACITY = 2048
 DEFAULT_MAX_RINGS = 16
 
+# The machine-readable twin of the docstring table above: every kind
+# the serving stack records.  fcheck-contract's ``event-vocab`` rule
+# holds the two sides together — a ``record("newkind", ...)`` without a
+# row here fails the gate, and a row nothing records is flagged stale —
+# so postmortem renderers and ``merge_events(kinds=...)`` filters can
+# trust this tuple as the full vocabulary.
+EVENT_KINDS = (
+    "admit", "reject_429", "shed", "hold", "pop", "route", "dequeue",
+    "device", "device_done", "finish", "fail", "cache_hit", "cordon",
+    "requeue", "watchdog_trip", "bundle", "span_open", "span_close",
+)
+
 
 class _Ring:
     """One thread's bounded event ring (oldest-overwrite)."""

@@ -144,7 +144,10 @@ class JobQuality:
 class PhaseLatency:
     """One fclat histogram from ``/metricsz``'s ``latency`` block: a
     log2-bucketed latency distribution (seconds) for one (name, tags)
-    pair — e.g. ``serve.phase.device`` at bucket n64_e96 / rung 2."""
+    pair — e.g. ``serve.phase.device`` at bucket n64_e96 / rung 2.
+    ``exemplars`` is the fcflight tail sidecar: per bucket key, the
+    retained worst (job_id, seconds) pairs, empty for histograms whose
+    observations carried no exemplar id."""
 
     name: str
     tags: Dict[str, str]
@@ -156,6 +159,8 @@ class PhaseLatency:
     p95_s: Optional[float]
     p99_s: Optional[float]
     buckets: Dict[str, int]
+    exemplars: Dict[str, Tuple[Tuple[str, float], ...]] = \
+        dataclasses.field(default_factory=dict)
 
     @classmethod
     def from_payload(cls, h: Dict[str, Any]) -> "PhaseLatency":
@@ -167,7 +172,11 @@ class PhaseLatency:
                    p50_s=h.get("p50_s"), p95_s=h.get("p95_s"),
                    p99_s=h.get("p99_s"),
                    buckets={str(k): int(v)
-                            for k, v in (h.get("buckets") or {}).items()})
+                            for k, v in (h.get("buckets") or {}).items()},
+                   exemplars={str(k): tuple((str(e), float(v))
+                                            for e, v in rows)
+                              for k, rows in
+                              (h.get("exemplars") or {}).items()})
 
 
 @dataclasses.dataclass(frozen=True)

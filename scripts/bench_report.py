@@ -18,6 +18,14 @@ is stdlib-only and is loaded by file path below, because importing it
 through the ``fastconsensus_tpu`` package would run the package
 ``__init__`` (graph.py -> jax) — on a box with no jax, or a wedged TPU
 transport where jax init hangs, the gate must still run.
+
+``--check`` additionally validates every metric key this gate reads
+against the committed fcheck-contract inventory
+(``runs/contract_r14.json``) before judging anything: a gate reading a
+renamed counter is vacuously green forever, so phantom keys fail fast
+with exit 2.  ``fastconsensus_tpu.analysis.contracts`` is safe to
+import here — the package ``__init__`` is lazy and the analysis layer
+is stdlib-only by construction (CI pins this with a poisoned ``jax``).
 """
 
 from __future__ import annotations
@@ -74,6 +82,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                         f"a regression (default: {history.DEFAULT_NMI_DROP})")
     p.add_argument("--markdown", action="store_true",
                    help="emit the trend report as markdown tables")
+    p.add_argument("--inventory", metavar="PATH",
+                   default=os.path.join(REPO, "runs",
+                                        "contract_r14.json"),
+                   help="fcheck-contract inventory artifact; with "
+                        "--check, every metric key this gate reads is "
+                        "validated against it at startup so a renamed "
+                        "counter fails fast instead of gating "
+                        "vacuously (pass an empty string to skip)")
     p.add_argument("--quiet", action="store_true",
                    help="with --check: print findings only, no report")
     args = p.parse_args(argv)
@@ -81,6 +97,37 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not 0.0 < args.max_drop_frac <= 1.0:
         p.error(f"--max-drop-frac {args.max_drop_frac} out of range "
                 f"(0, 1]")
+    if args.check and args.inventory:
+        # fcheck-contract fast-fail: a gate reading a key no writer
+        # produces can never fire, which looks exactly like "no
+        # regressions" — refuse to run on phantom keys
+        if not os.path.isfile(args.inventory):
+            print(f"bench_report: no contract inventory at "
+                  f"{args.inventory}; skipping the phantom-key check",
+                  file=sys.stderr)
+        else:
+            # run-as-script has scripts/ as sys.path[0]; the analysis
+            # layer lives in the (lazy, jax-free) package one level up
+            if REPO not in sys.path:
+                sys.path.insert(0, REPO)
+            from fastconsensus_tpu.analysis import contracts
+
+            phantom = []
+            for mod in (os.path.join(REPO, "fastconsensus_tpu", "obs",
+                                     "history.py"),
+                        os.path.abspath(__file__)):
+                phantom += [(mod, name, line) for name, line in
+                            contracts.phantom_reads_for(
+                                mod, args.inventory)]
+            if phantom:
+                print(f"bench_report: {len(phantom)} gate read(s) name "
+                      f"a metric the contract inventory knows no "
+                      f"writer for — the gate would be vacuously "
+                      f"green:", file=sys.stderr)
+                for mod, name, line in phantom:
+                    print(f"  PHANTOM: {os.path.relpath(mod, REPO)}:"
+                          f"{line}: '{name}'", file=sys.stderr)
+                return 2
     paths = args.paths or default_paths()
     groups = history.build_history(paths)
     if not groups:

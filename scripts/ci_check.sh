@@ -100,7 +100,12 @@ for pair in \
     bad_root_write.py:unguarded-root-write \
     bad_surface_budget.py:surface-count \
     bad_padding_ladder.py:padding-waste \
-    bad_footprint_budget.py:jaxpr-peak-bytes
+    bad_footprint_budget.py:jaxpr-peak-bytes \
+    bad_phantom_reader.py:phantom-reader \
+    bad_schema_drift.py:schema-drift \
+    bad_dead_counter.py:dead-counter \
+    bad_event_vocab.py:event-vocab \
+    bad_doc_drift.py:doc-drift
 do
     fixture="${pair%%:*}"
     rule="${pair##*:}"
@@ -118,7 +123,64 @@ do
         exit 1
     fi
 done
-echo "fixtures: all 8 rules fire with their ids"
+echo "fixtures: all 13 rules fire with their ids"
+
+echo "== fcheck-contract: name-contract gate (jax-free) =="
+# ISSUE 14 acceptance: the whole-program contract pass over the live
+# repo must be clean — every gate read has a writer, the typed client
+# matches the wire schema, no dead counters, event vocabulary in sync,
+# README tables current.  Runs with jax poisoned to pin the pass (and
+# the analysis package import) stdlib-only.
+JAX_PLATFORMS=cpu python - <<'CONTRACT_GATE'
+import sys
+
+sys.modules["jax"] = None  # any jax import now raises ImportError
+from fastconsensus_tpu.analysis.__main__ import main
+
+sys.exit(main(["fastconsensus_tpu/", "--no-jaxpr", "--only",
+               "phantom-reader,schema-drift,dead-counter,"
+               "event-vocab,doc-drift"]))
+CONTRACT_GATE
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcheck-contract gate failed (exit $rc)" >&2
+    exit 1
+fi
+
+echo "== fcheck-contract: committed inventory & README appendix drift =="
+# the committed runs/contract_r14.json and the README counters
+# reference are both generated from the writer inventory; regenerate
+# each and diff so a new counter cannot land without refreshing them
+JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
+    fastconsensus_tpu/ --no-jaxpr --quiet \
+    --emit-inventory /tmp/fc_contract_inv.json
+if ! diff -u runs/contract_r14.json /tmp/fc_contract_inv.json; then
+    echo "runs/contract_r14.json is stale — regenerate with" \
+         "python -m fastconsensus_tpu.analysis fastconsensus_tpu/" \
+         "--no-jaxpr --emit-inventory runs/contract_r14.json" >&2
+    exit 1
+fi
+JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
+    fastconsensus_tpu/ --no-jaxpr --quiet --emit-appendix \
+    > /tmp/fc_contract_appendix.md
+python - <<'APPENDIX_DIFF'
+import sys
+
+with open("README.md", encoding="utf-8") as fh:
+    readme = fh.read()
+begin = "<!-- fcheck-contract: counters begin -->"
+end = "<!-- fcheck-contract: counters end -->"
+committed = readme.split(begin, 1)[1].split(end, 1)[0].strip()
+with open("/tmp/fc_contract_appendix.md", encoding="utf-8") as fh:
+    generated = fh.read().strip()
+if committed != generated:
+    sys.stderr.write(
+        "README counters appendix is stale — regenerate with "
+        "python -m fastconsensus_tpu.analysis fastconsensus_tpu/ "
+        "--no-jaxpr --emit-appendix\n")
+    sys.exit(1)
+APPENDIX_DIFF
+echo "contract inventory + appendix in sync with the writers"
 
 echo "== fcheck-concurrency: pool stress under the lock-order recorder =="
 # ISSUE 7 acceptance: the recorder run over the pool stress reports an
@@ -245,9 +307,18 @@ for sub in accepted:          # admitted work must still finish
     client.wait(sub["job_id"], timeout=300)
 h = client.healthz()
 assert h.get("ok") and not h.get("draining"), h
-json.dumps(client.metricsz())  # /metricsz stays JSON end to end
+snapshot = client.metricsz()
+json.dumps(snapshot)          # /metricsz stays JSON end to end
+# ISSUE 14 runtime cross-check: every metric name the LIVE server
+# emits after real traffic must union cleanly with the committed
+# static writer inventory (runs/contract_r14.json) — closes the
+# static-model-vs-reality loop for the contract pass
+from fastconsensus_tpu.analysis import contracts
+
+n_checked = contracts.assert_covered(snapshot, "runs/contract_r14.json")
 print(f"fcserve smoke ok: cache hit served, {rejected} burst "
-      f"rejection(s), {len(accepted)} burst job(s) completed")
+      f"rejection(s), {len(accepted)} burst job(s) completed, "
+      f"{n_checked} live metric name(s) covered by the inventory")
 PYEOF
 rc=$?
 if [ $rc -ne 0 ]; then
@@ -987,9 +1058,12 @@ out=$(python scripts/bench_report.py --check --quiet \
     runs/bench_lfr1k_quality_r12.json \
     "$QUAL_DIR/bench_lfr1k_quality_r99.json" 2>&1)
 rc=$?
+# fcheck: ok=phantom-reader (greps bench_report's human finding text,
+# a message vocabulary from history.check_quality, not a metric name
+# any writer registers)
 if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "quality.final_agreement"; then
     echo "quality-regressed copy did not fail naming" \
-         "quality.final_agreement (exit $rc):" >&2
+         "quality.final_agreement (exit $rc):" >&2  # fcheck: ok=phantom-reader (same message literal)
     echo "$out" >&2
     exit 1
 fi

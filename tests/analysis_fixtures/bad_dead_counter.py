@@ -1,0 +1,18 @@
+"""Fixture: a counter is written but nothing ever reads it.
+
+``fixture.ticks.dropped`` has a writer and no gate, client, probe or
+documentation row — dead telemetry that silently rots.
+fcheck-contract must flag the write site with ``dead-counter``.
+"""
+
+CONTRACT_SPEC = {"rules": ["dead-counter"]}
+
+
+def tick(reg, dropped: bool) -> None:
+    reg.inc("fixture.ticks.total")
+    if dropped:
+        reg.inc("fixture.ticks.dropped")  # no reader anywhere
+
+
+def check_ticks(counters) -> bool:
+    return counters.get("fixture.ticks.total", 0) > 0

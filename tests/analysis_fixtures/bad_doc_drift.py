@@ -1,0 +1,26 @@
+"""Fixture: the README counters reference drifted from the writers.
+
+The appendix documents ``fixture.rounds.cold`` (no writer), misses
+``fixture.rounds.warm`` (written, undocumented), and lists
+``fixture.rounds.total`` as a gauge where the writer registers a
+counter.  fcheck-contract must flag all three with ``doc-drift``.
+"""
+
+CONTRACT_SPEC = {
+    "rules": ["doc-drift"],
+    "readme": """
+## Appendix: counters & series reference
+
+<!-- fcheck-contract: counters begin -->
+| name | kind | writers |
+|---|---|---|
+| `fixture.rounds.cold` | counter | bad_doc_drift.py |
+| `fixture.rounds.total` | gauge | bad_doc_drift.py |
+<!-- fcheck-contract: counters end -->
+""",
+}
+
+
+def count_round(reg) -> None:
+    reg.inc("fixture.rounds.total")
+    reg.inc("fixture.rounds.warm")

@@ -1,0 +1,18 @@
+"""Fixture: flight-recorder event kinds drift from the vocabulary.
+
+One ``record(...)`` call uses a typo'd kind (``admitt``) the declared
+vocabulary does not know, and the vocabulary still lists ``admit``
+which no site records — postmortem kind filters miss the former and
+trust a stale entry for the latter.  fcheck-contract must flag both
+with ``event-vocab``.
+"""
+
+CONTRACT_SPEC = {
+    "rules": ["event-vocab"],
+    "event_kinds": ["admit", "finish"],
+}
+
+
+def trace(flight, job: str) -> None:
+    flight.record("admitt", job=job)  # typo: not in the vocabulary
+    flight.record("finish", job=job)

@@ -1,0 +1,20 @@
+"""Fixture: a CI gate reads a counter name no writer produces.
+
+The writer registers ``serve.fixture.completed`` but the gate greps
+``serve.fixture.complete`` — the classic stale-gate bug: the check is
+vacuously green forever.  fcheck-contract must flag the read site with
+``phantom-reader``.
+"""
+
+CONTRACT_SPEC = {"rules": ["phantom-reader"]}
+
+
+def tick(reg) -> None:
+    reg.inc("serve.fixture.completed")
+    reg.gauge("serve.fixture.depth", 3)
+
+
+def check_fixture_gate(counters) -> bool:
+    done = counters.get("serve.fixture.complete", 0)  # typo'd reader
+    depth = counters.get("serve.fixture.depth", 0)
+    return done > 0 and depth < 10

@@ -1,0 +1,32 @@
+"""Fixture: typed client parser vs server emitter wire-schema drift.
+
+The server emitter writes ``busy_s`` but the client dataclass parses
+``busy_sec`` — the field silently reads its default forever, and the
+emitted ``busy_s`` is silently dropped.  fcheck-contract must flag
+both directions as ``schema-drift``: the phantom client key at the
+parser, and the dropped emitter key at the dict.
+"""
+
+CONTRACT_SPEC = {"rules": ["schema-drift"]}
+
+
+class DeviceRow:
+    """Typed jax-free view of one device-status payload row."""
+
+    @classmethod
+    def from_payload(cls, payload):
+        return cls(
+            device=payload["device"],
+            alive=payload["alive"],
+            jobs=payload["jobs"],
+            busy_sec=payload.get("busy_sec", 0.0),  # server says busy_s
+        )
+
+
+def render_device_row(dev) -> dict:
+    return {
+        "device": dev.index,
+        "alive": not dev.cordoned,
+        "jobs": dev.jobs_done,
+        "busy_s": dev.busy_seconds,
+    }

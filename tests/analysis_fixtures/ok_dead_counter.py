@@ -1,0 +1,19 @@
+"""Fixture: every written counter has a reader.
+
+Same shape as ``bad_dead_counter.py`` with the drop counter consumed
+by the gate too — fcheck-contract must stay silent.
+"""
+
+CONTRACT_SPEC = {"rules": ["dead-counter"]}
+
+
+def tick(reg, dropped: bool) -> None:
+    reg.inc("fixture.ticks.total")
+    if dropped:
+        reg.inc("fixture.ticks.dropped")
+
+
+def check_ticks(counters) -> bool:
+    total = counters.get("fixture.ticks.total", 0)
+    dropped = counters.get("fixture.ticks.dropped", 0)
+    return total > 0 and dropped == 0

@@ -1,0 +1,25 @@
+"""Fixture: the README counters reference matches the writers exactly.
+
+Same shape as ``bad_doc_drift.py`` with the appendix regenerated —
+every written metric documented under its real kind, no stale rows —
+so fcheck-contract must stay silent.
+"""
+
+CONTRACT_SPEC = {
+    "rules": ["doc-drift"],
+    "readme": """
+## Appendix: counters & series reference
+
+<!-- fcheck-contract: counters begin -->
+| name | kind | writers |
+|---|---|---|
+| `fixture.rounds.total` | counter | ok_doc_drift.py |
+| `fixture.rounds.warm` | counter | ok_doc_drift.py |
+<!-- fcheck-contract: counters end -->
+""",
+}
+
+
+def count_round(reg) -> None:
+    reg.inc("fixture.rounds.total")
+    reg.inc("fixture.rounds.warm")

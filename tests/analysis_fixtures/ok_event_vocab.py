@@ -1,0 +1,16 @@
+"""Fixture: recorded flight-event kinds and the vocabulary agree.
+
+Same shape as ``bad_event_vocab.py`` with every recorded kind in the
+vocabulary and every vocabulary entry recorded — fcheck-contract must
+stay silent.
+"""
+
+CONTRACT_SPEC = {
+    "rules": ["event-vocab"],
+    "event_kinds": ["admit", "finish"],
+}
+
+
+def trace(flight, job: str) -> None:
+    flight.record("admit", job=job)
+    flight.record("finish", job=job)

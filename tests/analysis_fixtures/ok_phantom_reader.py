@@ -1,0 +1,18 @@
+"""Fixture: every gate read names a metric some writer produces.
+
+Same shape as ``bad_phantom_reader.py`` with the reader spelled
+correctly — fcheck-contract must stay silent.
+"""
+
+CONTRACT_SPEC = {"rules": ["phantom-reader"]}
+
+
+def tick(reg) -> None:
+    reg.inc("serve.fixture.completed")
+    reg.gauge("serve.fixture.depth", 3)
+
+
+def check_fixture_gate(counters) -> bool:
+    done = counters.get("serve.fixture.completed", 0)
+    depth = counters.get("serve.fixture.depth", 0)
+    return done > 0 and depth < 10

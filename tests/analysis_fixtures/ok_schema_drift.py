@@ -1,0 +1,29 @@
+"""Fixture: typed client parser and server emitter agree field-for-field.
+
+Same shape as ``bad_schema_drift.py`` with the parser reading exactly
+the keys the emitter writes — fcheck-contract must stay silent.
+"""
+
+CONTRACT_SPEC = {"rules": ["schema-drift"]}
+
+
+class DeviceRow:
+    """Typed jax-free view of one device-status payload row."""
+
+    @classmethod
+    def from_payload(cls, payload):
+        return cls(
+            device=payload["device"],
+            alive=payload["alive"],
+            jobs=payload["jobs"],
+            busy_s=payload.get("busy_s", 0.0),
+        )
+
+
+def render_device_row(dev) -> dict:
+    return {
+        "device": dev.index,
+        "alive": not dev.cordoned,
+        "jobs": dev.jobs_done,
+        "busy_s": dev.busy_seconds,
+    }

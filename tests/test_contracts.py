@@ -1,0 +1,338 @@
+"""fcheck-contract suite: per-rule fixtures through lint_paths, the
+template resolver/matcher, the shell lexer, the committed inventory
+artifact + runtime cross-check, the README tables, and the
+bench_report phantom-key fast-fail."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+INVENTORY = os.path.join(REPO, "runs", "contract_r14.json")
+
+
+def _lint(name):
+    from fastconsensus_tpu.analysis import Report, lint_paths
+
+    return lint_paths([os.path.join(FIXTURES, name)], Report())
+
+
+# -- fixture pairs: each rule fires on bad_, stays silent on ok_ ------
+
+CONTRACT_FIXTURES = [
+    # (bad, ok, rule, n_bad): the expected finding counts pin the
+    # direction coverage — schema-drift fires both ways (phantom client
+    # key + dropped emitter key), event-vocab both ways (unknown kind +
+    # stale entry), doc-drift three ways (missing, stale, wrong kind)
+    ("bad_phantom_reader.py", "ok_phantom_reader.py", "phantom-reader", 1),
+    ("bad_schema_drift.py", "ok_schema_drift.py", "schema-drift", 2),
+    ("bad_dead_counter.py", "ok_dead_counter.py", "dead-counter", 1),
+    ("bad_event_vocab.py", "ok_event_vocab.py", "event-vocab", 2),
+    ("bad_doc_drift.py", "ok_doc_drift.py", "doc-drift", 3),
+]
+
+
+@pytest.mark.parametrize("bad,ok,rule,n_bad", CONTRACT_FIXTURES,
+                         ids=[r[2] for r in CONTRACT_FIXTURES])
+def test_contract_rule_fires_on_bad_and_not_on_ok(bad, ok, rule, n_bad):
+    report = _lint(bad)
+    hits = [d for d in report.diagnostics if d.rule == rule]
+    assert len(hits) == n_bad, [d.format() for d in report.diagnostics]
+    ok_report = _lint(ok)
+    assert not [d for d in ok_report.diagnostics if d.rule == rule], \
+        [d.format() for d in ok_report.diagnostics]
+
+
+def test_contract_spec_must_be_a_literal_dict(tmp_path):
+    from fastconsensus_tpu.analysis.contracts import check_contracts
+
+    p = tmp_path / "bad_spec.py"
+    p.write_text("CONTRACT_SPEC = ['not-a-dict']\n")
+    with pytest.raises(ValueError, match="must be a dict"):
+        check_contracts({str(p): p.read_text()})
+    p.write_text("CONTRACT_SPEC = {'rules': ['no-such-rule']}\n")
+    with pytest.raises(ValueError, match="no-such-rule"):
+        check_contracts({str(p): p.read_text()})
+    p.write_text("CONTRACT_SPEC = {'surprise': 1}\n")
+    with pytest.raises(ValueError, match="surprise"):
+        check_contracts({str(p): p.read_text()})
+
+
+# -- template resolution & matching -----------------------------------
+
+def test_constant_propagation_resolves_serve_style_writers(tmp_path):
+    """The write-site shapes the serve stack actually uses — f-string
+    over a loop index, IfExp over two literals, a param default, and a
+    module constant — all resolve to bounded templates."""
+    from fastconsensus_tpu.analysis import contracts
+
+    src = textwrap.dedent("""\
+        PREFIX = "serve.pool"
+
+        def tick(reg, klass="interactive"):
+            for arm in ("met", "missed"):
+                reg.inc(f"serve.slo.{klass}.{arm}")
+            for i in range(4):
+                reg.gauge(f"serve.device.{i}.jobs", i)
+            reg.inc(PREFIX + ".spawns")
+            reg.inc("a.b" if klass else "a.c")
+        """)
+    facts = contracts._scan_module("m.py", src)
+    tpls = set(facts.metrics)
+    assert "serve.slo.interactive.met" in tpls
+    assert "serve.slo.interactive.missed" in tpls
+    assert "serve.device.*.jobs" in tpls      # loop index -> wildcard
+    assert "serve.pool.spawns" in tpls        # module-const prefix
+    assert {"a.b", "a.c"} <= tpls             # IfExp union
+    assert facts.metrics["serve.device.*.jobs"]["kind"] == "gauge"
+
+
+def test_template_matching_is_segment_wise():
+    from fastconsensus_tpu.analysis.contracts import template_matches
+
+    assert template_matches("serve.device.*.jobs", "serve.device.3.jobs")
+    assert not template_matches("serve.device.*.jobs", "serve.device.jobs")
+    assert not template_matches("serve.device.*", "serve.device.3.jobs")
+    # wildcard is segment-local: it never swallows a dot
+    assert not template_matches("serve.*", "serve.cache.hit")
+    # template-vs-template (a templated read against a templated write)
+    assert template_matches("serve.slo.*.met", "serve.slo.*.met")
+    assert template_matches("host_sync.*", "host_sync.barrier")
+
+
+def test_dict_comprehension_and_subscript_store_emit_wire_keys():
+    """Regression for the fcshape counters block: a dict comprehension
+    over a literal tuple, and ``out[name] = ...`` with a loop-bound
+    name, both declare wire keys (first triaged as false-positive
+    phantoms of the real repo scan)."""
+    from fastconsensus_tpu.analysis import contracts
+
+    src = textwrap.dedent("""\
+        def stats(counters):
+            out = {name: counters.get(f"serve.shape.{name}", 0)
+                   for name in ("holds", "bypass", "edf_promotions")}
+            for extra in ("deadline_sheds",):
+                out[extra] = 0
+            return out
+        """)
+    facts = contracts._scan_module("m.py", src)
+    assert {"holds", "bypass", "edf_promotions",
+            "deadline_sheds"} <= set(facts.wire_keys)
+    # ...and the f-string reads resolved to real metric names
+    assert ("serve.shape.holds", 2) in facts.reads
+
+
+def test_module_vocabulary_tuple_declares_wire_keys():
+    """PHASE_STAMPS-style nested (name, stamp) tuples declare the plain
+    keys their consumers build dicts from."""
+    from fastconsensus_tpu.analysis import contracts
+
+    src = textwrap.dedent("""\
+        PHASES = (("queue_wait", "t_admit"), ("device", "t_start"))
+        """)
+    facts = contracts._scan_module("m.py", src)
+    assert {"queue_wait", "t_admit", "device",
+            "t_start"} <= set(facts.wire_keys)
+
+
+# -- the shell lexer (scripts/ci_check.sh reader inventory) -----------
+
+def test_shell_lexer_heredocs_quotes_and_comments():
+    from fastconsensus_tpu.analysis.contracts import _scan_shell
+
+    src = textwrap.dedent("""\
+        grep -q "serve.cache.hit" out.log
+        python - <<'PYEOF'
+        m = snapshot()
+        x = m.get("serve.queue.depth", 0)
+        PYEOF
+        echo done  # a comment quoting "serve.not.a.read"
+        cp artifact runs/bench_r9.json
+        """)
+    reads = dict(_scan_shell(src))
+    assert "serve.cache.hit" in reads
+    assert "serve.queue.depth" in reads          # heredoc parsed as python
+    assert "serve.not.a.read" not in reads       # trailing comment stripped
+    assert not any(n.endswith(".json") for n in reads)  # file names skipped
+
+
+# -- repo mode: the acceptance gate, jax-free --------------------------
+
+def test_repo_contract_gate_is_clean_with_jax_poisoned():
+    """ISSUE 14 acceptance: the five contract rules over the live repo
+    exit 0 in a process where any jax import raises."""
+    code = (
+        "import sys; sys.modules['jax'] = None; "
+        "from fastconsensus_tpu.analysis.__main__ import main; "
+        "sys.exit(main(['fastconsensus_tpu/', '--no-jaxpr', '--quiet', "
+        "'--only', 'phantom-reader,schema-drift,dead-counter,"
+        "event-vocab,doc-drift']))")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_gate_module_pragmas_keep_phantom_reads_for_clean():
+    """The two deliberate external-schema reads (obs/history.py VMESH
+    wrapper shapes) are pragma'd — the bench_report fast-fail helper
+    must honor those pragmas and report nothing on the live gate."""
+    from fastconsensus_tpu.analysis import contracts
+
+    path = os.path.join(REPO, "fastconsensus_tpu", "obs", "history.py")
+    assert contracts.phantom_reads_for(path, INVENTORY) == []
+
+
+def test_phantom_reads_for_detects_and_suppresses(tmp_path):
+    from fastconsensus_tpu.analysis import contracts
+
+    gate = tmp_path / "gate.py"
+    gate.write_text(textwrap.dedent("""\
+        def check(counters):
+            a = counters.get("serve.cache.hit", 0)
+            b = counters.get("serve.cache.hitz", 0)
+            return a + b
+        """))
+    assert [n for n, _ in
+            contracts.phantom_reads_for(str(gate), INVENTORY)] == \
+        ["serve.cache.hitz"]
+    gate.write_text(textwrap.dedent("""\
+        def check(counters):
+            a = counters.get("serve.cache.hit", 0)
+            # fcheck: ok=phantom-reader (external artifact schema)
+            b = counters.get("serve.cache.hitz", 0)
+            return a + b
+        """))
+    assert contracts.phantom_reads_for(str(gate), INVENTORY) == []
+
+
+# -- the committed inventory artifact ---------------------------------
+
+def test_committed_inventory_schema_and_coverage():
+    from fastconsensus_tpu.analysis import contracts
+
+    inv = contracts.load_inventory(INVENTORY)
+    assert inv["version"] == contracts.INVENTORY_VERSION
+    assert inv["rules"] == sorted(contracts.CONTRACT_RULES)
+    names = {m["name"] for m in inv["metrics"]}
+    # anchors across the serve/obs surface, including wildcard templates
+    assert "serve.cache.hit" in names
+    assert "serve.device.*.jobs" in names
+    assert "serve.slo.*.met" in names
+    for m in inv["metrics"]:
+        assert m["writers"], m  # every metric names its write sites
+        assert not m["writers"][0].startswith("/"), "paths must be repo-relative"
+    assert set(inv["events"]) <= set(inv["event_vocab"])
+    assert "watchdog_trip" in inv["event_vocab"]
+    assert inv["readers"]["gate"] and inv["readers"]["client"]
+
+
+def test_event_kinds_vocabulary_matches_flight_module():
+    from fastconsensus_tpu.analysis import contracts
+    from fastconsensus_tpu.obs import flight
+
+    inv = contracts.load_inventory(INVENTORY)
+    assert sorted(flight.EVENT_KINDS) == inv["event_vocab"]
+
+
+def test_assert_covered_accepts_known_and_names_strays():
+    from fastconsensus_tpu.analysis import contracts
+
+    snapshot = {
+        "fcobs": {"counters": {"serve.cache.hit": 3,
+                               "serve.slo.interactive.met": 1},
+                  "gauges": {"serve.queue.depth": 0}, "series": {}},
+        "latency": {"histograms": [{"name": "serve.e2e"}],
+                    "arrivals": {}, "dispatches": {}},
+    }
+    assert contracts.assert_covered(snapshot, INVENTORY) == 4
+    stray = {"fcobs": {"counters": {"serve.cache.hit": 1,
+                                    "serve.totally.unknown": 2}}}
+    assert contracts.uncovered(stray, INVENTORY) == \
+        ["serve.totally.unknown"]
+    with pytest.raises(AssertionError, match="serve.totally.unknown"):
+        contracts.assert_covered(stray, INVENTORY)
+
+
+def test_load_inventory_rejects_foreign_artifacts(tmp_path):
+    from fastconsensus_tpu.analysis import contracts
+
+    p = tmp_path / "other.json"
+    p.write_text(json.dumps({"tool": "something-else"}))
+    with pytest.raises(ValueError, match="not a fcheck-contract"):
+        contracts.load_inventory(str(p))
+
+
+# -- README tables (the doc-drift triage finds, pinned) ----------------
+
+def test_readme_rule_table_documents_every_rule_id():
+    """Triage regression: the README table documented the retired
+    ``jaxpr-huge-gather`` id and missed ``syntax-error`` /
+    ``trace-error`` entirely — every id in the analyzer vocabulary must
+    have a row, under its real name."""
+    from fastconsensus_tpu.analysis import contracts
+
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as fh:
+        facts = contracts._scan_readme(fh.read())
+    missing = contracts._rule_universe() - facts["rule_ids"]
+    assert not missing, f"README rule table missing: {sorted(missing)}"
+    assert "jaxpr-huge-gather" not in facts["rule_ids"]
+
+
+def test_readme_counters_appendix_matches_committed_inventory():
+    """The appendix between the fcheck-contract markers is generated
+    from the inventory — both are committed, so they must agree exactly
+    (CI regenerates the inventory itself; this pins the render)."""
+    from fastconsensus_tpu.analysis import contracts
+
+    inv = contracts.load_inventory(INVENTORY)
+    rendered = contracts.render_counters_appendix(inv).strip()
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as fh:
+        readme = fh.read()
+    section = readme.split(contracts.APPENDIX_BEGIN, 1)[1] \
+                    .split(contracts.APPENDIX_END, 1)[0].strip()
+    assert section == rendered
+
+
+# -- bench_report --check fast-fail -----------------------------------
+
+def _run_bench_report(*extra):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_report.py"),
+         "--check", "--quiet", *extra],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+
+
+def test_bench_report_check_passes_with_committed_inventory():
+    proc = _run_bench_report()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_bench_report_check_fast_fails_on_phantom_gate_keys(tmp_path):
+    """With an inventory that knows no writers, every gate key is a
+    phantom: the gate must refuse to judge (exit 2) naming them,
+    instead of running vacuously green."""
+    from fastconsensus_tpu.analysis import contracts
+
+    stripped = {"tool": contracts.INVENTORY_TOOL,
+                "version": contracts.INVENTORY_VERSION,
+                "rules": sorted(contracts.CONTRACT_RULES),
+                "metrics": [], "wire_keys": [], "events": [],
+                "event_vocab": [], "readers": {"gate": [], "client": []}}
+    p = tmp_path / "contract_stripped.json"
+    p.write_text(json.dumps(stripped))
+    proc = _run_bench_report("--inventory", str(p))
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "PHANTOM" in proc.stderr
+    assert "history.py" in proc.stderr
+
+
+def test_bench_report_check_skips_on_missing_inventory(tmp_path):
+    proc = _run_bench_report("--inventory",
+                             str(tmp_path / "nope.json"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "skipping the phantom-key check" in proc.stderr

@@ -37,10 +37,16 @@ def _spec(edges, n_nodes, priority=None, **over):
 @pytest.fixture
 def service():
     from fastconsensus_tpu.serve.server import ConsensusService, ServeConfig
+    from fastconsensus_tpu.serve.shaping import ShapingConfig
 
     # pin_sizing=False: the env pins are the resident server's posture;
-    # tests must not leak FCTPU_* into the rest of the suite
-    return ConsensusService(ServeConfig(queue_depth=4, pin_sizing=False))
+    # tests must not leak FCTPU_* into the rest of the suite.
+    # shed=False: on a loaded CI box a slow sample can push the deadline
+    # predictor past the default SLO slack and 429 an unrelated
+    # admission/cache test; shedding has its own coverage in
+    # test_shaping.py with primed estimators
+    return ConsensusService(ServeConfig(queue_depth=4, pin_sizing=False,
+                                        shaping=ShapingConfig(shed=False)))
 
 
 # -- sizing ladder / buckets ------------------------------------------

@@ -1,0 +1,152 @@
+"""Golden wire-schema test (fcheck-contract, ISSUE 14): snapshot a
+LIVE loopback server's ``/metricsz`` / ``/healthz`` / ``/status`` /
+``/debugz/slowest`` payloads after real traffic, then validate them
+field-for-field against the typed client parsers in a subprocess where
+any jax import raises — pinning that (a) every field the server emits
+is consumed by the matching parser (no silently-dropped keys), (b) the
+parsers run jax-free, and (c) the live metric names union cleanly with
+the committed static writer inventory (``runs/contract_r14.json``)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def wire_snapshots(karate_edges):
+    """Raw endpoint payloads from a live loopback server that ran one
+    real job (so timing/quality/latency/flight blocks are populated)."""
+    from fastconsensus_tpu.serve.client import ServeClient
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig,
+                                                make_http_server)
+
+    edges, _, ids = karate_edges
+    svc = ConsensusService(ServeConfig(queue_depth=4, pin_sizing=False))
+    httpd = make_http_server(svc, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    svc.start()
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout=30.0)
+    try:
+        sub = client.submit(edges=edges.tolist(), n_nodes=len(ids),
+                            algorithm="lpm", n_p=4, delta=0.1,
+                            max_rounds=2, seed=1)
+        client.wait(sub["job_id"], timeout=300)
+        snaps = {
+            "healthz": client.healthz(),
+            "metricsz": client.metricsz(),
+            "status": client.status(sub["job_id"]),
+            "slowest": client._request("/debugz/slowest"),
+        }
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        assert svc.drain(30)
+    return snaps
+
+
+def test_snapshots_carry_the_full_observability_surface(wire_snapshots):
+    """The fixture traffic must light up every block the golden check
+    below validates — an empty block would vacuously pass."""
+    m = wire_snapshots["metricsz"]
+    assert wire_snapshots["healthz"]["workers"]
+    assert m["latency"]["histograms"]
+    assert m["fcobs"]["counters"]
+    assert "shaping" in m and "devices" in m
+    assert wire_snapshots["status"].get("timing") is not None
+
+
+_VALIDATOR = textwrap.dedent("""\
+    import json
+    import sys
+
+    sys.modules["jax"] = None   # any jax import now raises ImportError
+
+    snap_path, repo = sys.argv[1], sys.argv[2]
+    sys.path.insert(0, repo)
+    with open(snap_path, encoding="utf-8") as fh:
+        snaps = json.load(fh)
+
+    from fastconsensus_tpu.analysis import contracts
+    from fastconsensus_tpu.serve import client as sc
+
+    cpath = repo + "/fastconsensus_tpu/serve/client.py"
+    with open(cpath, encoding="utf-8") as fh:
+        facts = contracts._scan_module(cpath, fh.read())
+    parser_keys = {cls: keys for cls, (_, keys) in facts.parsers.items()}
+
+    def field_for_field(cls_name, payload):
+        extra = sorted(set(payload) - parser_keys[cls_name])
+        assert not extra, (
+            f"{cls_name} silently drops live field(s) {extra} — "
+            f"consume them in from_payload or stop emitting them")
+
+    h = snaps["healthz"]
+    assert h["workers"], "no workers in /healthz"
+    for w in h["workers"]:
+        sc.WorkerState.from_payload(w)
+        field_for_field("WorkerState", w)
+
+    m = snaps["metricsz"]
+    lat = m["latency"]
+    assert lat["histograms"], "no latency histograms after a real job"
+    for row in lat["histograms"]:
+        sc.PhaseLatency.from_payload(row)
+        field_for_field("PhaseLatency", row)
+    for name, row in (lat.get("slo") or {}).items():
+        sc.SloStats.from_payload(name, row)
+        field_for_field("SloStats", row)
+    shaping = m["shaping"]
+    sc.ShapingStats.from_payload(shaping)
+    field_for_field("ShapingStats", shaping)
+    field_for_field("ShapingStats", shaping.get("counters") or {})
+
+    st = snaps["status"]
+    timing = st["timing"]
+    sc.JobTiming.from_payload(timing)
+    field_for_field("JobTiming", timing)
+    quality = st.get("quality")
+    if quality is not None:
+        sc.JobQuality.from_payload(quality)
+        field_for_field("JobQuality", quality)
+
+    for row in snaps["slowest"].get("slowest") or ():
+        sc.SlowJobExemplar.from_payload(row)
+        field_for_field("SlowJobExemplar", row)
+
+    # runtime half of the contract: live names vs the committed
+    # static writer inventory
+    inv_path = repo + "/runs/contract_r14.json"
+    n = contracts.assert_covered(m, inv_path)
+    assert n >= 10, f"suspiciously few live metrics ({n})"
+
+    # every top-level endpoint field is a known wire key
+    inv = contracts.load_inventory(inv_path)
+    wire = set(inv["wire_keys"])
+    for ep in ("healthz", "metricsz", "status"):
+        unknown = sorted(k for k in snaps[ep] if k not in wire)
+        assert not unknown, (
+            f"/{ep} emits top-level field(s) {unknown} missing from "
+            f"the wire-key universe — regenerate the inventory")
+    print(f"wire schema golden: {n} live metric name(s) covered")
+    """)
+
+
+def test_typed_parsers_cover_live_payloads_jax_free(wire_snapshots,
+                                                    tmp_path):
+    snap_path = tmp_path / "wire_snapshots.json"
+    snap_path.write_text(json.dumps(wire_snapshots))
+    proc = subprocess.run(
+        [sys.executable, "-c", _VALIDATOR, str(snap_path), REPO],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "wire schema golden" in proc.stdout
