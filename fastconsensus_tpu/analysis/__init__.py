@@ -1,8 +1,8 @@
 """fcheck: the project's static-analysis suite (AST lint + concurrency
-pass + jaxpr audit + footprint model + name contracts + runtime
-guards).
+pass + jaxpr audit + footprint model + fault flow + name contracts +
+runtime guards).
 
-Six layers, one report (run ``python -m fastconsensus_tpu.analysis``):
+Seven layers, one report (run ``python -m fastconsensus_tpu.analysis``):
 
 1. **AST lint** (analysis/astlint.py) — project-specific source rules:
    PRNG key reuse, Python control flow on traced values, retrace
@@ -24,7 +24,16 @@ Six layers, one report (run ``python -m fastconsensus_tpu.analysis``):
    is budgeted (``padding-waste``), and ``derive_chip_ceiling`` feeds
    the model back into serving (``serve --chip-max-edges auto`` and
    startup ``--warm`` validation).
-5. **Name contracts** (analysis/contracts.py) — the whole-program
+5. **Fault flow** (analysis/faults.py) — whole-program exception-flow
+   & resource-lifecycle rules: per-function raise sets propagated
+   through the call table and matched against handler coverage —
+   ``escape-thread-root``, ``swallowed-error``,
+   ``unmapped-http-error``, ``resource-leak``.  The committed
+   injection-site inventory (``--emit-fault-inventory`` ->
+   ``runs/faults_r15.json``) feeds the opt-in runtime harness
+   (serve/faultinject.py, ``FCTPU_FAULT_INJECT=<site_id>``) that the
+   ci_check injection campaign drives against a live pool.
+6. **Name contracts** (analysis/contracts.py) — the whole-program
    string-contract pass over the serving/observability surface:
    constant-propagated writer templates for every fcobs
    counter/gauge/series/histogram tag and flight event, the wire-key
@@ -35,7 +44,7 @@ Six layers, one report (run ``python -m fastconsensus_tpu.analysis``):
    ``event-vocab``, ``doc-drift``.  Jax-free; the committed
    ``runs/contract_r14.json`` inventory feeds a live ``/metricsz``
    cross-check (``contracts.assert_covered``).
-6. **Runtime guards** — :class:`CompileGuard`
+7. **Runtime guards** — :class:`CompileGuard`
    (analysis/recompile_guard.py) bounds XLA compilations over a region
    (the tier-1 compile-budget pins), and the opt-in lock-order recorder
    (analysis/lockorder.py, ``FCTPU_LOCK_ORDER=1``) logs the observed
@@ -82,9 +91,12 @@ def lint_paths(paths, report=None):
     third runs the whole-program concurrency analysis
     (analysis/concurrency.py: guarded-field, lock-order,
     blocking-under-lock, notify-outside-lock, unguarded-root-write)
-    over the same source set, and the fourth the name-contract pass
-    (analysis/contracts.py: repo mode when the scan covers the
-    serving/obs surface, fixture mode for CONTRACT_SPEC files).
+    over the same source set, the fourth the whole-program fault pass
+    (analysis/faults.py: escape-thread-root, swallowed-error,
+    unmapped-http-error, resource-leak), and the fifth the
+    name-contract pass (analysis/contracts.py: repo mode when the scan
+    covers the serving/obs surface, fixture mode for CONTRACT_SPEC
+    files).
     """
     import os
 
@@ -92,6 +104,7 @@ def lint_paths(paths, report=None):
                                                     summarize_key_params)
     from fastconsensus_tpu.analysis.concurrency import check_concurrency
     from fastconsensus_tpu.analysis.contracts import check_contracts
+    from fastconsensus_tpu.analysis.faults import check_faults
 
     if report is None:
         report = Report()
@@ -126,6 +139,9 @@ def lint_paths(paths, report=None):
     conc_diags, conc_suppressed = check_concurrency(sources)
     report.extend(conc_diags)
     report.n_suppressed += conc_suppressed
+    flt_diags, flt_suppressed = check_faults(sources)
+    report.extend(flt_diags)
+    report.n_suppressed += flt_suppressed
     con_diags, con_suppressed = check_contracts(sources)
     report.extend(con_diags)
     report.n_suppressed += con_suppressed

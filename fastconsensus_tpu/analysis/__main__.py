@@ -97,6 +97,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "/metricsz cross-check and the source of "
                              "the README counters appendix; needs a "
                              "package scan")
+    parser.add_argument("--emit-fault-inventory", metavar="PATH",
+                        default=None,
+                        help="write the fcheck-fault injection-site "
+                             "inventory artifact (runs/faults_rNN."
+                             "json) — every serve/ raise site + its "
+                             "statically claimed absorbing boundary; "
+                             "serve/faultinject.py patches these "
+                             "sites and the ci_check injection "
+                             "campaign asserts the claims hold live")
     parser.add_argument("--emit-appendix", action="store_true",
                         help="with --emit-inventory (or on a package "
                              "scan): print the README 'Counters & "
@@ -130,10 +139,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         from fastconsensus_tpu.analysis.concurrency import \
             CONCURRENCY_RULES
         from fastconsensus_tpu.analysis.contracts import CONTRACT_RULES
+        from fastconsensus_tpu.analysis.faults import FAULT_RULES
         from fastconsensus_tpu.analysis.footprint import FOOTPRINT_RULES
 
         known = set(ASTLINT_RULES) | set(CONCURRENCY_RULES) | \
-            set(FOOTPRINT_RULES) | set(CONTRACT_RULES) | {
+            set(FOOTPRINT_RULES) | set(CONTRACT_RULES) | \
+            set(FAULT_RULES) | {
             "jaxpr-f64", "jaxpr-device-put", "jaxpr-gather-size",
             "trace-error"}
         only = {r.strip() for r in args.only.split(",") if r.strip()}
@@ -245,6 +256,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         os.makedirs(out_dir, exist_ok=True)
         with open(args.footprint_out, "w", encoding="utf-8") as fh:
             _json.dump(report.footprint, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if args.emit_fault_inventory:
+        import json as _json
+
+        from fastconsensus_tpu.analysis import faults as fltmod
+
+        try:
+            finv = fltmod.fault_inventory_from_paths(paths)
+        except (ValueError, OSError) as e:
+            print(f"fcheck: {e}", file=sys.stderr)
+            return 2
+        out_dir = os.path.dirname(
+            os.path.abspath(args.emit_fault_inventory))
+        os.makedirs(out_dir, exist_ok=True)
+        with open(args.emit_fault_inventory, "w",
+                  encoding="utf-8") as fh:
+            _json.dump(finv, fh, indent=2, sort_keys=True)
             fh.write("\n")
 
     if args.emit_inventory or args.emit_appendix:

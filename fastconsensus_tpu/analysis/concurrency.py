@@ -227,6 +227,8 @@ class ConcurrencyAnalyzer:
         for filename, source in self.sources.items():
             try:
                 tree = ast.parse(source, filename=filename)
+            # fcheck: ok=swallowed-error (astlint reports the syntax
+            # error itself; this pass just skips the unparsable file)
             except SyntaxError:
                 continue  # astlint reports the syntax error itself
             mod = _ModuleInfo(_module_name(filename), filename, source)

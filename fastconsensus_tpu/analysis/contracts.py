@@ -687,12 +687,15 @@ class Universe:
 
     # -- assembly -----------------------------------------------------
 
-    def add_writer_facts(self, facts: ModuleFacts) -> None:
+    def add_metric_writers(self, facts: ModuleFacts) -> None:
         for tpl, info in facts.metrics.items():
             slot = self.metrics.setdefault(
                 tpl, {"kind": info["kind"], "writers": []})
             for ln in info["lines"]:
                 slot["writers"].append(f"{facts.path}:{ln}")
+
+    def add_writer_facts(self, facts: ModuleFacts) -> None:
+        self.add_metric_writers(facts)
         for key, ln in facts.wire_keys.items():
             self.wire_keys.setdefault(key, f"{facts.path}:{ln}")
         for kind, ln in facts.events:
@@ -892,10 +895,12 @@ def _find_pkg_root(sources: Dict[str, str]) -> Optional[str]:
 def _rule_universe() -> Set[str]:
     from fastconsensus_tpu.analysis.astlint import ASTLINT_RULES
     from fastconsensus_tpu.analysis.concurrency import CONCURRENCY_RULES
+    from fastconsensus_tpu.analysis.faults import FAULT_RULES
     from fastconsensus_tpu.analysis.footprint import FOOTPRINT_RULES
 
     return set(ASTLINT_RULES) | set(CONCURRENCY_RULES) | \
-        set(FOOTPRINT_RULES) | set(CONTRACT_RULES) | {
+        set(FOOTPRINT_RULES) | set(CONTRACT_RULES) | \
+        set(FAULT_RULES) | {
         "jaxpr-f64", "jaxpr-device-put", "jaxpr-gather-size",
         "trace-error"}
 
@@ -927,6 +932,10 @@ def build_universe(sources: Dict[str, str],
             # reverse check against its own parsers
             for key, ln in facts.wire_keys.items():
                 uni.wire_keys.setdefault(key, f"{facts.path}:{ln}")
+            # ...and its own client-side counters (retry hygiene) are
+            # real metrics the appendix must document, without letting
+            # client payload dicts into the writer wire universe
+            uni.add_metric_writers(facts)
         elif ap.endswith(os.sep + history_tail):
             uni.add_writer_facts(facts)
             uni.add_reads(facts, "gate")

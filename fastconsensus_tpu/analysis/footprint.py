@@ -286,6 +286,8 @@ def find_specs(paths: Iterable[str]) -> List[SurfaceSpec]:
             with open(f, encoding="utf-8") as fh:
                 src = fh.read()
             tree = ast.parse(src, filename=f)
+        # fcheck: ok=swallowed-error (unreadable/unparsable
+        # files are astlint's finding; the spec scan skips them)
         except (OSError, SyntaxError):
             continue
         for node in tree.body:
@@ -551,6 +553,8 @@ def _trace_peak_cached(kind, n_class, e_class, b, mode, n_p, algorithm):
     key = (kind, n_class, e_class, b, mode, n_p, algorithm)
     try:
         return _TRACE_CACHE[key]
+    # fcheck: ok=swallowed-error (cache miss, not an error:
+    # the trace below fills the entry)
     except KeyError:
         pass
     from fastconsensus_tpu.analysis import entrypoints as eps

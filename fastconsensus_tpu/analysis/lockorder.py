@@ -142,6 +142,9 @@ class _TracedLock:
 
     def acquire(self, blocking: bool = True,
                 timeout: float = -1) -> bool:
+        # fcheck: ok=resource-leak (lock-wrapper protocol: the
+        # paired release() is the caller's obligation, exactly
+        # as with the raw lock this class impersonates)
         ok = self._inner.acquire(blocking, timeout)
         if ok and _recorder is not None:
             _recorder.note_acquire(self._site, id(self))
@@ -164,6 +167,9 @@ class _TracedLock:
     def _is_owned(self) -> bool:
         if hasattr(self._inner, "_is_owned"):
             return self._inner._is_owned()
+        # fcheck: ok=resource-leak (ownership probe: a
+        # successful non-blocking acquire is released on the
+        # very next line)
         if self._inner.acquire(False):
             self._inner.release()
             return False
@@ -181,11 +187,16 @@ class _TracedLock:
         if hasattr(self._inner, "_acquire_restore"):
             self._inner._acquire_restore(state)
         else:
+            # fcheck: ok=resource-leak (Condition protocol: the
+            # paired release happened in _release_save before the
+            # wait; this is the wake-up re-acquire)
             self._inner.acquire()
         if _recorder is not None:
             _recorder.note_acquire(self._site, id(self))
 
     def __enter__(self) -> bool:
+        # fcheck: ok=resource-leak (context-manager protocol:
+        # __exit__ below is the paired release)
         return self.acquire()
 
     def __exit__(self, exc_type, exc, tb) -> None:

@@ -128,6 +128,9 @@ class CompileGuard:
 
             _mon._unregister_event_duration_listener_by_callback(
                 self._on_event)
+        # fcheck: ok=swallowed-error (best-effort unregister
+        # against a private jax API: the comment below is the
+        # whole story, and _active already neutralizes the hook)
         except Exception:
             # private API moved: the listener stays in jax's list (a
             # one-entry leak per guard) but _active keeps it a no-op

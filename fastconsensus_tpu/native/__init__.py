@@ -73,6 +73,9 @@ def _build() -> Optional[ctypes.CDLL]:
             # callers (it used to kill pytest collection).
             try:
                 os.remove(so_path)
+            # fcheck: ok=swallowed-error (removing the broken .so is
+            # itself best-effort; the build failure is reported via
+            # _build_error just below)
             except OSError:
                 pass
             if attempt == 1:

@@ -103,6 +103,10 @@ class ProfilerSession:
                 import jax
 
                 jax.profiler.stop_trace()
+            # fcheck: ok=swallowed-error (the warning IS the
+            # outlet: profiler teardown runs outside the serving
+            # path and has no registry to stamp by design — obs
+            # must not depend on obs)
             except Exception as e:  # noqa: BLE001
                 _logger.warning("jax.profiler stop_trace failed: %s", e)
             self.active = False

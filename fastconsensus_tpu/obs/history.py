@@ -215,6 +215,9 @@ def load_records(path: str) -> List[dict]:
                 continue
             try:
                 docs.append(json.loads(line))
+            # fcheck: ok=swallowed-error (a torn/corrupt history
+            # line is expected under concurrent appends; the
+            # loader keeps every parsable record)
             except json.JSONDecodeError:
                 continue
     records = []
@@ -630,6 +633,8 @@ def load_footprints(paths: List[str]) -> List[dict]:
         try:
             with open(path, encoding="utf-8") as fh:
                 doc = json.load(fh)
+        # fcheck: ok=swallowed-error (an unreadable footprint
+        # artifact simply drops out of the trend gate's window)
         except (OSError, json.JSONDecodeError):
             continue
         if not isinstance(doc, dict) or \

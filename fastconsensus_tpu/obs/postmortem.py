@@ -129,6 +129,10 @@ def write_bundle(reason: str, sections: Optional[Dict[str, Any]] = None,
                 json.dump(payload, fh, indent=1, default=_json_default)
                 fh.write("\n")
             written.append(f"{sec_name}.json")
+        # fcheck: ok=swallowed-error (a post-mortem writer that
+        # throws mid-incident destroys the evidence it exists to
+        # save: lossy beats throwing, and the manifest records
+        # which sections made it)
         except Exception:  # noqa: BLE001 — lossy beats throwing
             continue
 
@@ -137,6 +141,9 @@ def write_bundle(reason: str, sections: Optional[Dict[str, Any]] = None,
                   encoding="utf-8") as fh:
             faulthandler.dump_traceback(file=fh, all_threads=True)
         written.append("stacks.txt")
+    # fcheck: ok=swallowed-error (same lossy-beats-throwing contract
+    # as the sections above; stacks.txt is the most failure-prone
+    # section — faulthandler under a dying interpreter)
     except Exception:  # noqa: BLE001
         pass
 

@@ -310,6 +310,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                       shaping=shaping,
                       watchdog=watchdog,
                       flight_dir=args.flight_dir)
+    from fastconsensus_tpu.serve import faultinject
+
+    try:
+        # fcfault: arm the FCTPU_FAULT_INJECT site (if any) BEFORE the
+        # pool starts, so worker threads capture the injected callable;
+        # a bad site id fails startup loudly instead of injecting
+        # nothing silently
+        site = faultinject.maybe_install_from_env()
+    except (ValueError, ImportError, AttributeError) as e:
+        print(f"error: bad {faultinject.ENV_VAR}: {e}", file=sys.stderr)
+        return 2
+    if site is not None:
+        say(f"fault injection armed: {site}")
     try:
         service = ConsensusService(cfg).start()
     except ValueError as e:

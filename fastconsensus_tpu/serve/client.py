@@ -278,14 +278,21 @@ def _retry_after_s(header: Optional[str],
     header, else :data:`DEFAULT_RETRY_AFTER_S`.  Malformed or negative
     values fall back to the default — a client must never interpret a
     broken header as "hammer immediately" (or "wait forever")."""
+    from fastconsensus_tpu.obs import counters as obs_counters
+
+    reg = obs_counters.get_registry()
     for candidate in (payload.get("retry_after_s"), header):
         if candidate is None:
             continue
         try:
             v = float(candidate)
         except (TypeError, ValueError):
-            continue
-        if v > 0.0:
+            # a 429 whose hint cannot be parsed is a wire bug worth
+            # counting, not just skipping — the shaping stack promised
+            # an honest Retry-After
+            reg.inc("serve.client.retry_after_malformed")
+            v = None
+        if v is not None and v > 0.0:
             return v
     return DEFAULT_RETRY_AFTER_S
 

@@ -666,11 +666,27 @@ class WorkerPool:
     def _dispatch_loop(self) -> None:
         service = self.service
         while True:
-            batch = service.queue.pop_batch(service.config.max_batch,
-                                            group_key=service._group_key)
-            if batch is None:
-                break  # queue closed and drained
-            self.dispatch(batch)
+            batch = None
+            try:
+                batch = service.queue.pop_batch(
+                    service.config.max_batch,
+                    group_key=service._group_key)
+                if batch is None:
+                    break  # queue closed and drained
+                self.dispatch(batch)
+            except Exception as e:  # noqa: BLE001 — the dispatcher is
+                # the pool's only feed: one poisoned pop must fail its
+                # own batch, not silently kill the thread and starve
+                # every worker behind a healthy-looking queue
+                self._reg.inc("serve.pool.dispatch_errors")
+                for job in (batch or []):
+                    job.mark(STATE_FAILED,
+                             error=f"dispatch: {type(e).__name__}: {e}")
+                    self._reg.inc("serve.jobs.failed")
+                    self.service._record_timeline(job, failed=True)
+                _logger.exception(
+                    "fcpool: dispatch error, failed %d job(s)",
+                    len(batch or []))
         for w in self.workers:
             w.close()
 

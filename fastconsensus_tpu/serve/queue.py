@@ -262,6 +262,9 @@ class AdmissionQueue:
                                 try:
                                     blocks_solo = shaper.runs_solo(
                                         j.spec.bucket().key())
+                                # fcheck: ok=swallowed-error (a probe, not an action:
+                                # blocks_solo just stays False and the hold window
+                                # proceeds on the conservative default)
                                 except Exception:  # noqa: BLE001
                                     pass
                         if held_group is not None and held_group != g:

@@ -334,9 +334,18 @@ class ConsensusService:
                                  else self.config.drain_timeout_s)
         if ok:
             if self.config.cache_path:
-                n = self.cache.spill(self.config.cache_path)
-                _logger.info("fcserve: spilled %d cached result(s) to %s",
-                             n, self.config.cache_path)
+                try:
+                    n = self.cache.spill(self.config.cache_path)
+                    _logger.info(
+                        "fcserve: spilled %d cached result(s) to %s",
+                        n, self.config.cache_path)
+                except OSError:
+                    # a full/unwritable disk must not turn a clean drain
+                    # into exit 1 — the cache is an optimization, the
+                    # drain contract is the product
+                    self._reg.inc("serve.cache.persist_write_failed")
+                    _logger.exception(
+                        "fcserve: cache spill failed; draining anyway")
             self._export_trace()
         else:
             # some worker is STILL RUNNING a job: exporting now would
@@ -613,6 +622,9 @@ class ConsensusService:
             # is just the grid lookup.
             bucket_key = job.spec.bucket().key()
             self._lat.arrivals.mark(bucket_key)
+        # fcheck: ok=swallowed-error (deliberate: the arrival
+        # mark is telemetry; an unbucketable spec still fails
+        # as its own job at pack time, visibly)
         except Exception:  # noqa: BLE001 — rate tracking must never
             pass           # reject a job the bucketer will judge later
         cached = self.cache.get(job.key)
@@ -654,6 +666,9 @@ class ConsensusService:
             # rate alone would predict fills mixed-config traffic can
             # never deliver.
             self._lat.group_arrivals.mark(job.spec.batch_group())
+        # fcheck: ok=swallowed-error (deliberate: the group
+        # mark is telemetry; _group_key independently falls
+        # back to solo for the same spec)
         except Exception:  # noqa: BLE001 — grouping must never reject
             pass           # a job; _group_key falls back to solo
         try:
@@ -664,6 +679,9 @@ class ConsensusService:
             try:
                 e.retry_after_s = self.shaper.retry_after_s(
                     e.depth, bucket_key)
+            # fcheck: ok=swallowed-error (the 429 re-raises right
+            # below — only the optional retry-after refinement is
+            # dropped, and the client has its static default)
             except Exception:  # noqa: BLE001 — estimator trouble must
                 pass           # never mask the backpressure signal
             raise
@@ -1396,7 +1414,27 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_fault(self, e: BaseException) -> None:
+        """Last-resort 500: an exception the route arms never mapped
+        still answers the promised JSON error body instead of dropping
+        the connection with a raw traceback, and stamps
+        ``serve.http.unhandled_errors`` so the gap is visible on
+        /metricsz (fcheck-fault: unmapped-http-error)."""
+        self.service._reg.inc("serve.http.unhandled_errors")
+        _logger.exception("fcserve http: unhandled handler error")
+        try:
+            self._send(500, {"error": "internal error: "
+                                      f"{type(e).__name__}: {e}"})
+        except OSError:  # fcheck: ok=swallowed-error: the client socket is already gone — there is no one left to answer; the counter above carries the failure
+            pass
+
     def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        try:
+            self._do_post()
+        except Exception as e:  # noqa: BLE001 — catch-all status mapping
+            self._send_fault(e)
+
+    def _do_post(self) -> None:
         if self.path.rstrip("/") != "/submit":
             self._send(404, {"error": f"no such endpoint {self.path}"})
             return
@@ -1445,6 +1483,12 @@ class _Handler(BaseHTTPRequestHandler):
                     "cached": job.state == STATE_DONE})
 
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        try:
+            self._do_get()
+        except Exception as e:  # noqa: BLE001 — catch-all status mapping
+            self._send_fault(e)
+
+    def _do_get(self) -> None:
         path = self.path.rstrip("/")
         if path == "/healthz":
             stats = self.service.stats()

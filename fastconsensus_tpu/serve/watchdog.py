@@ -233,8 +233,20 @@ class HangWatchdog:
             t.join(timeout)
 
     def _poll_loop(self) -> None:
+        from fastconsensus_tpu.obs import counters as obs_counters
+
+        reg = obs_counters.get_registry()
         while not self._stop.wait(self.config.poll_s):
-            for trip in self.check():
+            try:
+                trips = self.check()
+            except Exception:  # noqa: BLE001 — a poisoned estimate must
+                # not kill the only thread that detects hangs; count it
+                # so /metricsz shows a watchdog that polls but cannot
+                # judge
+                reg.inc("serve.watchdog.poll_errors")
+                _logger.exception("fcflight: watchdog check failed")
+                continue
+            for trip in trips:
                 cb = self.on_trip
                 if cb is not None:
                     try:
@@ -242,6 +254,7 @@ class HangWatchdog:
                     except Exception:  # noqa: BLE001 — the trip handler
                         # writes bundles and cordons; a bug there must
                         # not kill the watchdog itself
+                        reg.inc("serve.watchdog.trip_errors")
                         _logger.exception(
                             "fcflight: watchdog trip handler failed")
 

@@ -80,12 +80,14 @@ def _load(backend: str) -> dict:
     path = _rates_path(backend)
     if _cache is not None and _cache_path == path:
         return _cache
-    rates: dict = {}
     try:
         with open(path) as fh:
             rates = json.load(fh)
     except (OSError, ValueError):
-        pass
+        # absent or corrupt calibration is the cold-start default, not
+        # an error: every get_rate() answers None and callers fall back
+        # to their static cost model
+        rates = {}
     _cache, _cache_path = rates, path
     return rates
 

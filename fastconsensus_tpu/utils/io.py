@@ -51,6 +51,9 @@ def read_edgelist(path: str) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarr
                 return _compact(u64, v64,
                                 None if w64 is None
                                 else w64.astype(np.float32))
+    # fcheck: ok=swallowed-error (the fallthrough IS the
+    # handling: the pure-Python parser below re-reads the
+    # file and ITS errors name the offending line)
     except (ImportError, ValueError):
         # No toolchain, or a line the fast parser rejects: fall through to
         # the pure-Python parse, whose errors name the offending line.

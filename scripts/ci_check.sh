@@ -105,7 +105,11 @@ for pair in \
     bad_schema_drift.py:schema-drift \
     bad_dead_counter.py:dead-counter \
     bad_event_vocab.py:event-vocab \
-    bad_doc_drift.py:doc-drift
+    bad_doc_drift.py:doc-drift \
+    bad_escape_thread_root.py:escape-thread-root \
+    bad_swallowed_error.py:swallowed-error \
+    bad_unmapped_http.py:unmapped-http-error \
+    bad_resource_leak.py:resource-leak
 do
     fixture="${pair%%:*}"
     rule="${pair##*:}"
@@ -123,7 +127,7 @@ do
         exit 1
     fi
 done
-echo "fixtures: all 13 rules fire with their ids"
+echo "fixtures: all 17 rules fire with their ids"
 
 echo "== fcheck-contract: name-contract gate (jax-free) =="
 # ISSUE 14 acceptance: the whole-program contract pass over the live
@@ -1205,6 +1209,132 @@ if [ $rc -ne 0 ] || ! printf '%s' "$out" | grep -q "flight events by kind"; then
     exit 1
 fi
 echo "fcflight smoke ok: cordon-on-stall, SIGQUIT dump, reader round-trip"
+
+echo "== fcfault: injection-site inventory drift =="
+# runs/faults_r15.json is generated from the fault pass's raise-set
+# analysis; regenerate and diff so a new raise site (or a moved
+# boundary) cannot land without refreshing the committed claims the
+# injection campaign below tests against
+JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
+    fastconsensus_tpu/ --no-jaxpr --quiet \
+    --emit-fault-inventory /tmp/fc_fault_inv.json
+if ! diff -u runs/faults_r15.json /tmp/fc_fault_inv.json; then
+    echo "runs/faults_r15.json is stale — regenerate with" \
+         "python -m fastconsensus_tpu.analysis fastconsensus_tpu/" \
+         "--no-jaxpr --emit-fault-inventory runs/faults_r15.json" >&2
+    exit 1
+fi
+echo "fault inventory in sync with the raise-set analysis"
+
+echo "== fcfault: 3-site injection campaign (queue / device / drain path) =="
+# Every site's statically claimed absorbing boundary
+# (runs/faults_r15.json) is tested against a LIVE loopback pool: the
+# injected job fails as itself, failure counters are stamped, sibling
+# jobs complete, and SIGTERM drain still exits 0.
+FAULT_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR" "$SERVE_DIR" "$BATCH_DIR" "$POOL_DIR" "$FAULT_DIR"; [ -n "$SERVE_PID" ] && kill -9 "$SERVE_PID" 2>/dev/null' EXIT
+for campaign in queue device drain; do
+    case "$campaign" in
+        queue) SITE="fastconsensus_tpu.serve.server:ConsensusService.submit:QueueFull" ;;
+        device) SITE="fastconsensus_tpu.serve.bucketer:pad_to_bucket:ValueError" ;;
+        drain) SITE="fastconsensus_tpu.serve.cache:ResultCache.spill:OSError" ;;
+    esac
+    FAULT_PORT=$(python - <<'PYEOF'
+import socket
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1])
+s.close()
+PYEOF
+)
+    JAX_PLATFORMS=cpu FCTPU_FAULT_INJECT="$SITE" XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+        python -m fastconsensus_tpu.serve --host 127.0.0.1 \
+        --port "$FAULT_PORT" --devices 2 \
+        --cache-file "$FAULT_DIR/cache_$campaign.npz" --quiet &
+    SERVE_PID=$!
+    JAX_PLATFORMS=cpu python - "$FAULT_PORT" "$campaign" "$SITE" <<'PYEOF'
+import sys
+import time
+
+from fastconsensus_tpu.serve.client import (Backpressure, JobFailed,
+                                            ServeClient)
+from fastconsensus_tpu.utils.io import read_edgelist
+
+port, campaign, site = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+client = ServeClient(f"http://127.0.0.1:{port}", timeout=30.0)
+for _ in range(150):
+    try:
+        client.healthz()
+        break
+    except Exception:
+        time.sleep(0.2)
+else:
+    sys.exit("fcserve never came up")
+edges, _, ids = read_edgelist("examples/karate_club.txt")
+spec = dict(edges=edges.tolist(), n_nodes=len(ids), algorithm="lpm",
+            n_p=4, delta=0.1, max_rounds=2, seed=1)
+
+if campaign == "queue":
+    # shot 1: submit raises the injected QueueFull -> the client must
+    # see honest 429 backpressure, not a dropped connection
+    try:
+        client.submit(**spec)
+        sys.exit("injected QueueFull did not surface as backpressure")
+    except Backpressure as e:
+        assert e.payload.get("backpressure"), e.payload
+    # the site healed after one shot: the sibling submit is admitted
+    # and completes — one poisoned admission lost exactly one job
+    r = client.run(timeout=300, **spec)
+    assert r.get("partitions"), r
+elif campaign == "device":
+    # shot 1: pad_to_bucket throws on the device path; the static
+    # boundary claim is _run_solo_job / _run_batch, so the job fails
+    # AS ITSELF (counted, flight-recorded) and nothing else dies
+    sub = client.submit(**spec)
+    try:
+        client.wait(sub["job_id"], timeout=300)
+        sys.exit("injected device fault did not fail the job")
+    except JobFailed as e:
+        assert "fault injected" in str(e.payload.get("error", "")), \
+            e.payload
+    m = client.metricsz()
+    counters = m["fcobs"]["counters"]
+    assert counters.get("serve.jobs.failed", 0) >= 1, counters
+    # sibling job on the 2-worker pool: admitted after the shot is
+    # spent, must complete normally
+    r = client.run(timeout=300, **dict(spec, seed=2))
+    assert r.get("partitions"), r
+    h = client.healthz()
+    assert h.get("ok"), h
+else:
+    # drain path: complete one job so the spill has content, then let
+    # SIGTERM hit the armed ResultCache.spill — the drain must treat
+    # the OSError as a counted, logged loss, not an exit-1
+    r = client.run(timeout=300, **spec)
+    assert r.get("partitions"), r
+print(f"fcfault {campaign} campaign ok ({site})")
+PYEOF
+    rc=$?
+    if [ $rc -ne 0 ]; then
+        echo "fcfault $campaign campaign failed (exit $rc)" >&2
+        exit $rc
+    fi
+    kill -TERM "$SERVE_PID"
+    wait "$SERVE_PID"
+    rc=$?
+    SERVE_PID=""
+    if [ $rc -ne 0 ]; then
+        echo "fcserve did not drain cleanly under $campaign-path" \
+             "injection (exit $rc)" >&2
+        exit $rc
+    fi
+    if [ "$campaign" = "drain" ] && [ -s "$FAULT_DIR/cache_drain.npz" ]; then
+        echo "drain-path injection did not reach ResultCache.spill" \
+             "(cache file was written)" >&2
+        exit 1
+    fi
+done
+echo "fcfault campaign ok: 3 sites injected, every boundary held, drains clean"
 
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"

@@ -1,0 +1,29 @@
+"""Violating fixture: an exception escapes a thread root.
+
+The poller thread's loop calls a helper whose raise set (inferred and
+propagated through the call table by analysis/faults.py) includes
+``ValueError``; nothing on the path catches it, so ``Thread.run``
+prints a traceback and the thread dies silently — the serving-stack
+shape where the dispatcher or watchdog thread evaporates while
+/healthz stays green.
+"""
+
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self.estimates = {}
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._poll_once()
+
+    def _poll_once(self):
+        if not self.estimates:
+            raise ValueError("poisoned estimate table")
+        return min(self.estimates.values())

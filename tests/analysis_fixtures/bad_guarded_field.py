@@ -14,7 +14,8 @@ class SpanBuffer:
     def __init__(self):
         self._lock = threading.Lock()
         self._events = []
-        self._thread = threading.Thread(target=self._worker)
+        self._thread = threading.Thread(target=self._worker,
+                                        daemon=True)
 
     def _worker(self):
         while True:

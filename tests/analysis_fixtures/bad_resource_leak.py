@@ -1,0 +1,16 @@
+"""Violating fixture: a file whose close is skipped on the error path.
+
+The header read sits between ``open`` and ``close`` with no ``with``
+and no ``finally`` — an ``OSError`` (or a bad-header ``ValueError``)
+leaks the descriptor.  Long-lived servers turn this shape into fd
+exhaustion.
+"""
+
+
+def read_header(path):
+    fh = open(path, encoding="utf-8")
+    line = fh.readline()
+    if not line.startswith("#"):
+        raise ValueError(f"{path}: missing header line")
+    fh.close()
+    return line

@@ -14,7 +14,7 @@ def worker_loop():
 
 
 def start():
-    t = threading.Thread(target=worker_loop)
+    t = threading.Thread(target=worker_loop, daemon=True)
     t.start()
     return t
 
@@ -27,7 +27,8 @@ def read_progress():
 class Poller:
     def __init__(self):
         self.last_seen = None
-        self._thread = threading.Thread(target=self._poll)
+        self._thread = threading.Thread(target=self._poll,
+                                        daemon=True)
 
     def _poll(self):
         while True:

@@ -1,0 +1,20 @@
+"""Violating fixture: an except body that eats the error silently.
+
+The loader's ``except OSError: pass`` neither re-raises, returns an
+error value, assigns a fallback, stamps a counter, nor records a
+flight event — the one failure mode the observability stack cannot
+see.  (``json.load`` inside the ``with`` also pins the builtin-raiser
+table: the handler would need ``ValueError`` coverage to absorb it.)
+"""
+
+import json
+
+
+def load_rates(path):
+    rates = {"default": 1.0}
+    try:
+        with open(path, encoding="utf-8") as fh:
+            rates.update(json.load(fh))
+    except OSError:
+        pass
+    return rates

@@ -1,0 +1,22 @@
+"""Violating fixture: an HTTP handler with an unmapped exception.
+
+``do_GET``'s query parser raises ``ValueError`` on a malformed id and
+no except arm maps it to a 4xx/5xx response — the client sees a
+dropped connection (or a raw-traceback 500) instead of the promised
+JSON error body.
+"""
+
+
+class Handler:
+    def do_GET(self):
+        job_id = self._parse_id()
+        self._send(200, {"job_id": job_id})
+
+    def _parse_id(self):
+        path = str(getattr(self, "path", ""))
+        if not path.startswith("/status/"):
+            raise ValueError(f"malformed id in {path!r}")
+        return path[len("/status/"):]
+
+    def _send(self, code, payload):
+        self.last = (code, payload)

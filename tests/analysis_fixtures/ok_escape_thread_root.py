@@ -1,0 +1,31 @@
+"""Compliant fixture: the thread root absorbs its raise set.
+
+Same poller as bad_escape_thread_root.py, but the loop wraps the
+fallible helper in an ``except Exception`` arm that records the error
+as a counted value — the thread survives a poisoned estimate and the
+failure is visible.
+"""
+
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self.estimates = {}
+        self.poll_errors = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            try:
+                self._poll_once()
+            except Exception:
+                self.poll_errors += 1
+
+    def _poll_once(self):
+        if not self.estimates:
+            raise ValueError("poisoned estimate table")
+        return min(self.estimates.values())

@@ -1,0 +1,13 @@
+"""Compliant fixture: the file is context-managed.
+
+Same header read as bad_resource_leak.py inside ``with`` — the
+descriptor closes on every path, error or not.
+"""
+
+
+def read_header(path):
+    with open(path, encoding="utf-8") as fh:
+        line = fh.readline()
+        if not line.startswith("#"):
+            raise ValueError(f"{path}: missing header line")
+        return line

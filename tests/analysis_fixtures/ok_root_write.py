@@ -15,7 +15,7 @@ def worker_loop():
 
 
 def start():
-    t = threading.Thread(target=worker_loop)
+    t = threading.Thread(target=worker_loop, daemon=True)
     t.start()
     return t
 
@@ -30,7 +30,8 @@ class Poller:
     def __init__(self):
         self._plock = threading.Lock()
         self.last_seen = None
-        self._thread = threading.Thread(target=self._poll)
+        self._thread = threading.Thread(target=self._poll,
+                                        daemon=True)
 
     def _poll(self):
         while True:

@@ -1,0 +1,19 @@
+"""Compliant fixture: the handler records the failure as a value.
+
+Same loader as bad_swallowed_error.py, but the except body assigns the
+documented cold-start fallback (an error-value outlet) — callers see
+the default and nothing disappears silently.
+"""
+
+import json
+
+
+def load_rates(path):
+    try:
+        with open(path, encoding="utf-8") as fh:
+            loaded = json.load(fh)
+    except (OSError, ValueError):
+        loaded = {}
+    rates = {"default": 1.0}
+    rates.update(loaded)
+    return rates

@@ -1,0 +1,25 @@
+"""Compliant fixture: every handler-reachable exception maps to a
+status code.
+
+Same handler as bad_unmapped_http.py, with the ``ValueError`` the
+parser can raise mapped to a 400 JSON error response.
+"""
+
+
+class Handler:
+    def do_GET(self):
+        try:
+            job_id = self._parse_id()
+        except ValueError as e:
+            self._send(400, {"error": str(e)})
+            return
+        self._send(200, {"job_id": job_id})
+
+    def _parse_id(self):
+        path = str(getattr(self, "path", ""))
+        if not path.startswith("/status/"):
+            raise ValueError(f"malformed id in {path!r}")
+        return path[len("/status/"):]
+
+    def _send(self, code, payload):
+        self.last = (code, payload)

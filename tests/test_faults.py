@@ -1,0 +1,480 @@
+"""fcheck-fault suite: per-rule fixtures through lint_paths, raise-set
+inference units (cross-function propagation, the builtin-raiser table,
+pragma suppression), the committed injection-site inventory artifact,
+the serve/faultinject.py harness (which must stay jax-free), one
+end-to-end injection under a live 2-worker pool, and regression tests
+for the fault-triage fixes this pass forced (dispatch loop, watchdog
+poll, retry-after hygiene, cache-spill drain)."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+INVENTORY = os.path.join(REPO, "runs", "faults_r15.json")
+
+# a site hosted by the harness module itself: jax-free end to end, so
+# the poisoned-import subprocess below can arm and trip it
+SELF_SITE = ("fastconsensus_tpu.serve.faultinject:"
+             "installed_sites:RuntimeError")
+
+
+def _lint(name):
+    from fastconsensus_tpu.analysis import Report, lint_paths
+
+    return lint_paths([os.path.join(FIXTURES, name)], Report())
+
+
+def _check(src, filename="mod.py"):
+    from fastconsensus_tpu.analysis.faults import check_faults
+
+    return check_faults({filename: textwrap.dedent(src)})
+
+
+# -- fixture pairs: each rule fires on bad_, stays silent on ok_ ------
+
+FAULT_FIXTURES = [
+    ("bad_escape_thread_root.py", "ok_escape_thread_root.py",
+     "escape-thread-root", 1),
+    ("bad_swallowed_error.py", "ok_swallowed_error.py",
+     "swallowed-error", 1),
+    ("bad_unmapped_http.py", "ok_unmapped_http.py",
+     "unmapped-http-error", 1),
+    ("bad_resource_leak.py", "ok_resource_leak.py",
+     "resource-leak", 1),
+]
+
+
+@pytest.mark.parametrize("bad,ok,rule,n_bad", FAULT_FIXTURES,
+                         ids=[r[2] for r in FAULT_FIXTURES])
+def test_fault_rule_fires_on_bad_and_not_on_ok(bad, ok, rule, n_bad):
+    report = _lint(bad)
+    hits = [d for d in report.diagnostics if d.rule == rule]
+    assert len(hits) == n_bad, [d.format() for d in report.diagnostics]
+    ok_report = _lint(ok)
+    assert not [d for d in ok_report.diagnostics if d.rule == rule], \
+        [d.format() for d in ok_report.diagnostics]
+
+
+# -- raise-set inference ----------------------------------------------
+
+def test_raise_set_propagates_through_helper_chain_to_thread_root():
+    """The escape walks raise sets through two call hops: the root's
+    target calls a helper whose own helper raises — no function in the
+    chain handles it, so the thread dies."""
+    diags, _ = _check("""\
+        import threading
+
+        class Poller:
+            def start(self):
+                threading.Thread(target=self._loop,
+                                 daemon=True).start()
+
+            def _loop(self):
+                while True:
+                    self._once()
+
+            def _once(self):
+                self._parse("x")
+
+            def _parse(self, raw):
+                raise ValueError(raw)
+        """)
+    hits = [d for d in diags if d.rule == "escape-thread-root"]
+    assert len(hits) == 1, [d.format() for d in diags]
+
+
+def test_caller_side_handler_with_outlet_clears_the_escape():
+    """Same chain, but the loop body absorbs the ValueError and keeps
+    an outlet (a counter write) — the raise set is emptied at the
+    handler, so nothing reaches the root."""
+    diags, _ = _check("""\
+        import threading
+
+        class Poller:
+            def __init__(self):
+                self.errors = 0
+
+            def start(self):
+                threading.Thread(target=self._loop,
+                                 daemon=True).start()
+
+            def _loop(self):
+                while True:
+                    try:
+                        self._parse("x")
+                    except ValueError:
+                        self.errors += 1
+
+            def _parse(self, raw):
+                raise ValueError(raw)
+        """)
+    assert not diags, [d.format() for d in diags]
+
+
+def test_builtin_raiser_table_feeds_the_swallow_rule():
+    """No explicit ``raise`` anywhere: the OSError comes from the
+    curated builtin-raiser table (``open``), and the bare ``pass`` arm
+    swallows it."""
+    diags, _ = _check("""\
+        def load(path):
+            data = None
+            try:
+                with open(path) as fh:
+                    data = fh.read()
+            except OSError:
+                pass
+            return data
+        """)
+    hits = [d for d in diags if d.rule == "swallowed-error"]
+    assert len(hits) == 1, [d.format() for d in diags]
+
+
+def test_builtin_raiser_table_reaches_http_handlers():
+    """``json.loads`` raising JSONDecodeError is table knowledge too:
+    a ``do_POST`` that parses a body with no mapping arm is an
+    unmapped-http-error even though the module never raises."""
+    diags, _ = _check("""\
+        import json
+
+        class Handler:
+            def do_POST(self):
+                body = json.loads(self.raw)
+                self._send(200, body)
+
+            def _send(self, code, payload):
+                self.last = (code, payload)
+        """)
+    hits = [d for d in diags if d.rule == "unmapped-http-error"]
+    assert len(hits) == 1, [d.format() for d in diags]
+
+
+def test_pragma_suppresses_and_is_counted():
+    src = """\
+        def load(path):
+            data = None
+            try:
+                with open(path) as fh:
+                    data = fh.read()
+            # fcheck: ok=swallowed-error (fixture: reason text)
+            except OSError:
+                pass
+            return data
+        """
+    diags, suppressed = _check(src)
+    assert not [d for d in diags if d.rule == "swallowed-error"], \
+        [d.format() for d in diags]
+    assert suppressed == 1
+
+
+# -- the committed injection-site inventory ---------------------------
+
+def test_fault_inventory_schema_and_site_ids():
+    from fastconsensus_tpu.serve import faultinject
+
+    with open(INVENTORY, encoding="utf-8") as fh:
+        inv = json.load(fh)
+    assert inv["tool"] == "fcheck-fault"
+    assert inv["version"] == 1
+    assert inv["module_prefix"] == "fastconsensus_tpu.serve"
+    sites = inv["sites"]
+    assert sites and sites == sorted(sites,
+                                     key=lambda s: s["site_id"])
+    for site in sites:
+        assert set(site) == {"site_id", "file", "function",
+                             "exception", "kind", "lines",
+                             "boundary", "injectable"}
+        module, qualname, exc = faultinject.parse_site_id(
+            site["site_id"])
+        assert module.startswith("fastconsensus_tpu.serve")
+        assert qualname == site["function"]
+        assert exc == site["exception"]
+        assert site["kind"] in ("raise", "builtin-call")
+        assert site["lines"] == sorted(site["lines"])
+        if site["injectable"]:
+            # injectable means every absorber is a REAL caller-side
+            # handler — sentinel boundaries (<external>, <thread-root>)
+            # cannot be exercised by entry injection
+            assert site["boundary"], site["site_id"]
+            assert all(not b.startswith("<") for b in site["boundary"]), \
+                site["site_id"]
+
+
+def test_fault_inventory_matches_the_source_tree():
+    """The committed artifact's site set must match what the pass
+    derives from today's sources (ci_check.sh diffs the full document;
+    this pins the drift-prone axes in-process)."""
+    from fastconsensus_tpu.analysis.faults import \
+        fault_inventory_from_paths
+
+    regen = fault_inventory_from_paths(
+        [os.path.join(REPO, "fastconsensus_tpu")])
+    with open(INVENTORY, encoding="utf-8") as fh:
+        committed = json.load(fh)
+    assert {s["site_id"]: s["injectable"]
+            for s in regen["sites"]} == \
+        {s["site_id"]: s["injectable"] for s in committed["sites"]}
+
+
+# -- the injection harness --------------------------------------------
+
+def test_parse_site_id_shapes():
+    from fastconsensus_tpu.serve import faultinject
+
+    assert faultinject.parse_site_id(
+        "pkg.mod:Class.method:OSError") == \
+        ("pkg.mod", "Class.method", "OSError")
+    for bad in ("pkg.mod:OSError", "a:b:c:d", "pkg.mod::OSError", ""):
+        with pytest.raises(ValueError):
+            faultinject.parse_site_id(bad)
+
+
+def test_install_raises_for_count_then_heals_and_uninstalls():
+    from fastconsensus_tpu.serve import faultinject
+
+    try:
+        faultinject.install(SELF_SITE, count=2)
+        faultinject.install(SELF_SITE, count=99)  # idempotent no-op
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="fault injected"):
+                faultinject.installed_sites()
+        # healed: the wrapper calls through, and the real function
+        # reports the site as still installed
+        assert faultinject.installed_sites() == [SELF_SITE]
+        assert faultinject.uninstall(SELF_SITE)
+        assert not faultinject.uninstall(SELF_SITE)
+        assert faultinject.installed_sites() == []
+    finally:
+        faultinject.uninstall_all()
+
+
+def test_make_exc_builds_project_backpressure_types():
+    """QueueFull takes positional ints — the constructed instance must
+    carry the attributes the 429 arm reads (``.depth``), or the
+    injected fault would crash the very handler under test."""
+    from fastconsensus_tpu.serve import faultinject
+    from fastconsensus_tpu.serve.queue import QueueFull
+
+    e = faultinject._make_exc(QueueFull, "a:b:QueueFull")
+    assert isinstance(e, QueueFull)
+    assert e.depth == 0 and e.max_depth == 0
+
+
+def test_env_arming(monkeypatch):
+    from fastconsensus_tpu.serve import faultinject
+
+    monkeypatch.delenv(faultinject.ENV_VAR, raising=False)
+    assert faultinject.maybe_install_from_env() is None
+    monkeypatch.setenv(faultinject.ENV_VAR, SELF_SITE)
+    try:
+        assert faultinject.maybe_install_from_env() == SELF_SITE
+        with pytest.raises(RuntimeError, match=SELF_SITE.split(":")[1]):
+            faultinject.installed_sites()
+    finally:
+        faultinject.uninstall_all()
+    monkeypatch.setenv(faultinject.ENV_VAR, "not-a-site")
+    with pytest.raises(ValueError):
+        faultinject.maybe_install_from_env()
+
+
+def test_faultinject_imports_and_injects_without_jax():
+    """The harness arms from serve/__main__.py before the service (and
+    jax) come up, and the pre-commit hook path is jax-free — so the
+    module must import, install, trip, and heal with jax poisoned."""
+    script = textwrap.dedent(f"""\
+        import sys
+        sys.modules["jax"] = None  # any "import jax" now raises
+        from fastconsensus_tpu.serve import faultinject
+        faultinject.install({SELF_SITE!r})
+        try:
+            faultinject.installed_sites()
+        except RuntimeError as e:
+            assert "fault injected" in str(e), e
+        else:
+            raise SystemExit("injection did not fire")
+        assert faultinject.installed_sites() == [{SELF_SITE!r}]
+        assert faultinject.uninstall_all() == [{SELF_SITE!r}]
+        """)
+    proc = subprocess.run(
+        [sys.executable, "-c", script], cwd=REPO,
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr
+
+
+# -- end-to-end: one inventoried site under a live pool ---------------
+
+def _ring(n, chords=0, shift=7):
+    idx = np.arange(n)
+    edges = [np.stack([idx, (idx + 1) % n], 1)]
+    if chords:
+        c = np.arange(chords)
+        edges.append(np.stack([c % n, (c + shift) % n], 1))
+    return np.concatenate(edges).astype(np.int64)
+
+
+def _spec(edges, n_nodes, **over):
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.jobs import JobSpec
+
+    kwargs = dict(algorithm="louvain", n_p=4, tau=0.2, delta=0.02,
+                  max_rounds=2, seed=0)
+    kwargs.update(over)
+    return JobSpec(edges=np.asarray(edges, dtype=np.int64),
+                   n_nodes=n_nodes, config=ConsensusConfig(**kwargs))
+
+
+def _wait(jobs, timeout=180.0):
+    deadline = time.monotonic() + timeout
+    for j in jobs:
+        while j.state not in ("done", "failed"):
+            assert time.monotonic() < deadline, j.describe()
+            time.sleep(0.02)
+
+
+def test_injected_device_fault_fails_job_as_itself():
+    """An inventoried device-path site armed single-shot under a
+    2-worker pool: the injected job fails AS the injected exception
+    (no worker death, no cordon), the flight recorder logs the
+    failure, and the next job rides the healed site to completion."""
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.obs import flight as obs_flight
+    from fastconsensus_tpu.serve import faultinject
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    site = "fastconsensus_tpu.serve.bucketer:pad_to_bucket:ValueError"
+    svc = ConsensusService(ServeConfig(queue_depth=8, pin_sizing=False,
+                                       devices=2)).start()
+    base = obs_counters.get_registry().counters()
+    try:
+        faultinject.install(site, count=1)
+        job = svc.submit(_spec(_ring(12, chords=6), 12, seed=1))
+        _wait([job])
+        assert job.state == "failed", job.describe()
+        assert "fault injected" in (job.error or ""), job.error
+        assert site in job.error
+        # the fault failed ONE job, not the worker: nothing cordoned
+        assert svc.stats()["cordoned_devices"] == []
+        sibling = svc.submit(_spec(_ring(12, chords=6), 12, seed=2))
+        _wait([sibling])
+        assert sibling.state == "done", sibling.error
+        since = obs_counters.get_registry().counters_since(base)
+        assert since.get("serve.jobs.failed", 0) >= 1, since
+        fails = obs_flight.get_flight_recorder().events(
+            job=job.job_id, kinds={"fail"})
+        assert fails, "flight recorder missed the injected failure"
+    finally:
+        faultinject.uninstall_all()
+        assert svc.drain(60)
+
+
+# -- regressions for the triage fixes this pass forced ----------------
+
+def test_retry_after_malformed_counts_and_falls_back():
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.client import (DEFAULT_RETRY_AFTER_S,
+                                                _retry_after_s)
+
+    reg = obs_counters.get_registry()
+    base = reg.counters()
+    assert _retry_after_s("soon", {}) == DEFAULT_RETRY_AFTER_S
+    # a malformed body hint falls through to a good header
+    assert _retry_after_s("2", {"retry_after_s": "nope"}) == 2.0
+    since = reg.counters_since(base)
+    assert since.get("serve.client.retry_after_malformed", 0) == 2
+    # negative is out-of-contract but parseable: default, no count
+    base = reg.counters()
+    assert _retry_after_s("-3", {}) == DEFAULT_RETRY_AFTER_S
+    assert reg.counters_since(base).get(
+        "serve.client.retry_after_malformed", 0) == 0
+
+
+def test_watchdog_poll_survives_a_poisoned_check():
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.watchdog import (HangWatchdog,
+                                                  WatchdogConfig)
+
+    wd = HangWatchdog(latency=object(),
+                      config=WatchdogConfig(poll_s=0.01))
+
+    def boom(now=None):
+        raise RuntimeError("poisoned estimate")
+
+    wd.check = boom
+    base = obs_counters.get_registry().counters()
+    wd.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            since = obs_counters.get_registry().counters_since(base)
+            if since.get("serve.watchdog.poll_errors", 0) >= 2:
+                break
+            time.sleep(0.02)
+        assert wd._thread.is_alive(), \
+            "watchdog thread died on a check() exception"
+        since = obs_counters.get_registry().counters_since(base)
+        assert since.get("serve.watchdog.poll_errors", 0) >= 2, since
+    finally:
+        wd.stop()
+
+
+def test_dispatch_error_fails_its_batch_and_keeps_dispatching():
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(queue_depth=8, pin_sizing=False,
+                                       devices=2)).start()
+    base = obs_counters.get_registry().counters()
+    real_dispatch = svc.pool.dispatch
+
+    def boom(batch):
+        svc.pool.dispatch = real_dispatch  # poison exactly one pop
+        raise RuntimeError("poisoned dispatch")
+
+    svc.pool.dispatch = boom
+    try:
+        job = svc.submit(_spec(_ring(12, chords=6), 12, seed=11))
+        _wait([job])
+        assert job.state == "failed", job.describe()
+        assert "dispatch: RuntimeError" in job.error, job.error
+        # the dispatcher thread survived to feed the next batch
+        sibling = svc.submit(_spec(_ring(12, chords=6), 12, seed=12))
+        _wait([sibling])
+        assert sibling.state == "done", sibling.error
+        since = obs_counters.get_registry().counters_since(base)
+        assert since.get("serve.pool.dispatch_errors", 0) == 1, since
+        assert since.get("serve.jobs.failed", 0) >= 1, since
+    finally:
+        assert svc.drain(60)
+
+
+def test_cache_spill_failure_keeps_the_drain_clean(tmp_path):
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+
+    svc = ConsensusService(ServeConfig(
+        queue_depth=4, pin_sizing=False, devices=2,
+        cache_path=str(tmp_path / "cache.npz"))).start()
+    base = obs_counters.get_registry().counters()
+    job = svc.submit(_spec(_ring(12, chords=6), 12, seed=21))
+    _wait([job])
+    assert job.state == "done", job.error
+
+    def no_disk(path):
+        raise OSError(28, "No space left on device", path)
+
+    svc.cache.spill = no_disk
+    assert svc.drain(60), "a failed spill must not fail the drain"
+    since = obs_counters.get_registry().counters_since(base)
+    assert since.get("serve.cache.persist_write_failed", 0) == 1, since
+    assert not (tmp_path / "cache.npz").exists()
