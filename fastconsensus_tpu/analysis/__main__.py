@@ -90,6 +90,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "bench-history artifact (runs/"
                              "footprint_rNN.json; scripts/bench_report."
                              "py renders and gates it)")
+    parser.add_argument("--waste-budget", type=float, default=None,
+                        metavar="FRAC",
+                        help="cost-dead-compute threshold: run-level "
+                             "fraction of rounds-executable FLOPs the "
+                             "committed frontier series bills to frozen "
+                             "vertices (default pinned in cost."
+                             "WASTE_BUDGET_DEFAULT)")
+    parser.add_argument("--cost-out", metavar="PATH", default=None,
+                        help="also write the compute-cost block alone as "
+                             "a bench-history artifact (runs/"
+                             "cost_rNN.json; scripts/bench_report.py "
+                             "renders and gates it)")
     parser.add_argument("--emit-inventory", metavar="PATH", default=None,
                         help="write the fcheck-contract writer/reader "
                              "inventory artifact (runs/contract_rNN."
@@ -139,12 +151,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from fastconsensus_tpu.analysis.concurrency import \
             CONCURRENCY_RULES
         from fastconsensus_tpu.analysis.contracts import CONTRACT_RULES
+        from fastconsensus_tpu.analysis.cost import COST_RULES
         from fastconsensus_tpu.analysis.faults import FAULT_RULES
         from fastconsensus_tpu.analysis.footprint import FOOTPRINT_RULES
 
         known = set(ASTLINT_RULES) | set(CONCURRENCY_RULES) | \
             set(FOOTPRINT_RULES) | set(CONTRACT_RULES) | \
-            set(FAULT_RULES) | {
+            set(FAULT_RULES) | set(COST_RULES) | {
             "jaxpr-f64", "jaxpr-device-put", "jaxpr-gather-size",
             "trace-error"}
         only = {r.strip() for r in args.only.split(",") if r.strip()}
@@ -240,6 +253,54 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             return 2
 
+    # -- compute-cost pass (analysis/cost.py): FLOP/byte/roofline model
+    # of the same surface.  Mirrors the footprint gating, with one
+    # simplification: all three cost rules run on the jax-free ladder
+    # mirror, so --no-jaxpr never narrows the selection — it only skips
+    # the traced gate/calibration tables (full package scans pay them).
+    from fastconsensus_tpu.analysis import cost as costmod
+
+    run_cost = args.footprint     # --footprint/--no-footprint govern
+    cost_specs = []               # the whole static surface family
+    if run_cost is not False and (
+            only is None or only & set(costmod.COST_RULES)):
+        try:
+            cost_specs = costmod.find_specs(paths)
+        except ValueError as e:
+            print(f"fcheck: bad COST_SPEC: {e}", file=sys.stderr)
+            return 2
+        if run_cost is None:
+            run_cost = _inside_package(paths) or bool(cost_specs)
+    elif run_cost is None:
+        run_cost = False
+    if run_cost and only is not None and \
+            not (only & set(costmod.COST_RULES)):
+        run_cost = False
+    if run_cost:
+        overrides = {k: v for k, v in (
+            ("waste_budget", args.waste_budget),) if v is not None}
+        specs = cost_specs or [costmod.CostSpec()]
+        if overrides:
+            specs = [dataclasses_replace(s, **overrides) for s in specs]
+        sel = set(only & set(costmod.COST_RULES)) if only is not None \
+            else set(costmod.COST_RULES)
+        try:
+            for spec in specs:
+                # the repo-default posture carries the traced gate +
+                # calibration tables into the report; fixture postures,
+                # --only iteration and --no-jaxpr runs stay mirror-only
+                full = not cost_specs and only is None \
+                    and args.jaxpr is not False
+                diags, block = costmod.evaluate(spec, rules=sel,
+                                                with_table=full)
+                report.extend(diags)
+                if full:
+                    report.cost = block
+        except Exception as e:  # noqa: BLE001 — analyzer must not crash CI
+            print(f"fcheck: cost pass failed to run: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+
     if only is not None:
         report.diagnostics = [d for d in report.diagnostics
                               if d.rule in only]
@@ -256,6 +317,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         os.makedirs(out_dir, exist_ok=True)
         with open(args.footprint_out, "w", encoding="utf-8") as fh:
             _json.dump(report.footprint, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    if args.cost_out:
+        if report.cost is None:
+            print("fcheck: --cost-out needs the cost pass on the repo "
+                  "posture (no fixture specs, no --only, jaxpr on)",
+                  file=sys.stderr)
+            return 2
+        import json as _json
+
+        out_dir = os.path.dirname(os.path.abspath(args.cost_out))
+        os.makedirs(out_dir, exist_ok=True)
+        with open(args.cost_out, "w", encoding="utf-8") as fh:
+            _json.dump(report.cost, fh, indent=2, sort_keys=True)
             fh.write("\n")
 
     if args.emit_fault_inventory:

@@ -895,12 +895,13 @@ def _find_pkg_root(sources: Dict[str, str]) -> Optional[str]:
 def _rule_universe() -> Set[str]:
     from fastconsensus_tpu.analysis.astlint import ASTLINT_RULES
     from fastconsensus_tpu.analysis.concurrency import CONCURRENCY_RULES
+    from fastconsensus_tpu.analysis.cost import COST_RULES
     from fastconsensus_tpu.analysis.faults import FAULT_RULES
     from fastconsensus_tpu.analysis.footprint import FOOTPRINT_RULES
 
     return set(ASTLINT_RULES) | set(CONCURRENCY_RULES) | \
         set(FOOTPRINT_RULES) | set(CONTRACT_RULES) | \
-        set(FAULT_RULES) | {
+        set(FAULT_RULES) | set(COST_RULES) | {
         "jaxpr-f64", "jaxpr-device-put", "jaxpr-gather-size",
         "trace-error"}
 

@@ -1,0 +1,925 @@
+"""fcheck-cost: static compute-cost & roofline model of the serving
+stack — the FLOP/byte complement of fcheck-footprint's memory model.
+
+fcheck-footprint answered "will this executable *fit*"; nothing yet
+answered "what will it *cost*".  The gap has a price the repo has
+already measured: fcqual proved on-device that most lfr1k vertices
+leave the active frontier after round 1 (``frontier_frac_by_round``
+0.807 -> 0.059 in the committed quality artifact), yet the engine
+re-runs the base detector over ALL n vertices every round — exactly
+the waste vertex-parallel Louvain and pruning formulations eliminate.
+Before the frontier-masking and batched-first tentpoles land, this
+module prices the surface so those PRs have a quantified bill to
+shrink, and so the serving layer stops guessing ``1.0 s`` for buckets
+it has never timed.
+
+1. **Eqn-level cost visitor** (:func:`eqn_cost`): walks a traced
+   jaxpr and accumulates FLOPs (``dot_general`` = 2*M*N*K, scatter
+   family = one update-add per update element, elementwise = one op
+   per output element) and HBM byte traffic (operand + result bytes
+   per equation — deliberately fusion-blind, so the model is a
+   conservative ceiling exactly like ``peak_live_bytes``), recursing
+   through pjit/cond/scan sub-jaxprs and bounding ``while`` trip
+   counts by the sweep budget mirrored from models/louvain.py
+   (:data:`MAX_SWEEPS`).  ``cond`` branches price at the max branch.
+2. **Jax-free ladder mirror** (:func:`mirror_cost`): a closed-form
+   fit of the visitor over the bucket ladder, split at the
+   matmul/hash detection-path flip (``MATMUL_MAX_N``), linear in
+   ensemble width and batch rung.  The mirror is what the pre-commit
+   hook, the fixture postures and the *runtime* consume — priors must
+   never import jax.  Fit coefficients are pinned against the traced
+   visitor by tests/test_cost.py (ratio band).
+3. **Roofline** (:class:`MachineModel`): ``est_device_s =
+   max(flops/peak_flops, hbm_bytes/bandwidth) + dispatch overhead``.
+   The default machine is the CPU CI host's effective envelope,
+   calibrated so the modeled ``rounds`` executable at the committed
+   serve_load bucket lands inside the measured ``serve.phase.device``
+   band — and *kept* calibrated by the bench_report gate below.
+4. **Runtime feedback**: :func:`static_service_prior` (the cold
+   ``TrafficShaper`` / ``LatencyRegistry.service_estimate`` fallback
+   that replaces the hardcoded 1.0 s guess) and :func:`spill_weight`
+   (``StickyScheduler`` backlog weighting — a queued 100 s bucket is
+   not the same backlog as a queued 50 ms bucket).
+
+Three fcheck rules ride on the model (all jax-free via the mirror, so
+``--only`` with cost rules keeps ``--no-jaxpr`` semantics trivially):
+
+* ``cost-dead-compute``    — the fraction of a full consensus run's
+  rounds-executable FLOPs attributable to vertices a frontier mask
+  would freeze (computed from the committed fcqual frontier series,
+  assuming vertex-proportional round cost) exceeds the pinned waste
+  budget (``--waste-budget``).  The committed ``runs/cost_r16.json``
+  artifact carries the bill per round — the target number the
+  frontier-masking PR must shrink.
+* ``cost-duality``         — prices the solo-vs-batch executable
+  duality per representative bucket: the per-job batched cost must
+  save at least ``duality_min_saving`` of the solo cost (default 0.0:
+  batching must never be worse per job).  This is the measured cost
+  of the two-path engine the batched-first refactor removes.
+* ``cost-roofline-regress``— fixture mode: the mirror's
+  ``est_device_s`` for a ``"kind@bucket"`` baseline entry grew beyond
+  ``regress_frac``.  The history-facing twin lives in
+  obs/history.check_costs: the newest committed ``runs/cost_rNN.json``
+  vs its predecessor, per gate row.
+
+**Fixture mode**: a scanned source file may define a module-level
+``COST_SPEC = {...}`` literal (see :meth:`CostSpec.from_mapping`);
+the analyzer evaluates the rules against that posture — how the
+bad_/ok_ fixtures in tests/analysis_fixtures/ drive each rule.
+
+**Report / artifact schema** (the ``cost`` block of the ``--json``
+report, and the committed ``runs/cost_rNN.json`` artifact rendered
+and gated by ``scripts/bench_report.py``)::
+
+    {
+      "tool": "fcheck-cost", "version": 1,
+      "config":  {max_nodes, max_edges, max_batch, n_p, algorithm,
+                  waste_budget, duality_min_saving, regress_frac,
+                  peak_flops, hbm_bytes_per_s, dispatch_overhead_s},
+      "frontier_series": [...],        # fcqual frontier_frac_by_round
+      "dead_compute": {bucket, n_p, rounds, round_flops,
+                       per_round: [{round, frontier_frac, dead_frac,
+                                    dead_flops}...],
+                       run_dead_frac, late_round_dead_frac,
+                       waste_budget},
+      "duality": [ {bucket, batch, solo_est_s, batch_est_s,
+                    per_job_est_s, per_job_saving_frac} ... ],
+      "gate": [ {kind, bucket, batch, flops, hbm_bytes,
+                 arith_intensity, est_device_s} ... ],   # traced
+      "buckets": [ {bucket, n_class, e_class, flops, hbm_bytes,
+                    arith_intensity, est_device_s} ... ], # mirror
+      "calibration": {bucket, n_p, kind, est_device_ms}   # traced
+    }
+
+``gate`` / ``buckets`` / ``calibration`` are filled on full package
+scans only (they trace); the rules themselves never do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from fastconsensus_tpu.analysis.diagnostics import Diagnostic
+from fastconsensus_tpu.analysis.footprint import (
+    BATCH_RUNGS, MATMUL_MAX_N, MIN_NODE_CLASS, SurfaceSpec, _aval_bytes,
+    batch_rungs, edge_classes, grid_up, reachable)
+
+COST_RULES = ("cost-dead-compute", "cost-duality", "cost-roofline-regress")
+
+# --------------------------------------------------------------------
+# CI-pinned budgets and the committed frontier series.
+# --------------------------------------------------------------------
+
+# Run-level dead-compute budget.  The committed fcqual frontier series
+# bills 61% of the lfr1k run's rounds-executable FLOPs to frozen
+# vertices (late rounds ~89%); 0.75 passes that measured bill with
+# headroom while a frontier that collapses even faster (more waste per
+# run) trips the rule and forces the masking work.
+WASTE_BUDGET_DEFAULT = 0.75
+
+# Per-job batched saving floor: batching must never cost MORE per job
+# than solo dispatch (the whole point of the rung ladder); any
+# positive floor is a posture choice (fixtures pin the rule with 0.9).
+DUALITY_MIN_SAVING_DEFAULT = 0.0
+
+# est_device_s growth vs a committed baseline that counts as a
+# roofline regression (fixture mode here; obs/history.check_costs
+# applies the same default across committed cost artifacts).
+REGRESS_FRAC_DEFAULT = 0.5
+
+# The committed fcqual frontier trajectory
+# (runs/bench_lfr1k_quality_r12.json telemetry.quality
+# .frontier_frac_by_round) — the measured fraction of vertices still
+# active entering each round.  Pinned against the artifact by
+# tests/test_cost.py so the dead-compute bill always reflects what the
+# device actually measured, not a stale copy.
+FRONTIER_SERIES_DEFAULT = (0.807, 0.533, 0.161, 0.059)
+
+# The lfr1k posture the dead-compute bill prices: synth.lfr_graph(1000,
+# 0.3) -> 5638 edges -> bucket n1024_e6144 at the fcqual config's
+# ensemble width (n_p=20).
+DEAD_BUCKET_DEFAULT = (1024, 6144)
+DEAD_N_P_DEFAULT = 20
+
+# models/louvain.py local_move sweep budget (``max_sweeps`` default) —
+# the trip bound the visitor applies to every ``lax.while_loop`` and
+# the iteration count baked into the mirror fits.  Mirrored here so
+# the jax-free half never imports the model; pinned by tests.
+MAX_SWEEPS = 32
+
+# --------------------------------------------------------------------
+# The machine model (roofline).
+# --------------------------------------------------------------------
+
+# Effective envelope of the CPU CI host, calibrated against the
+# committed serve_load history: the modeled rounds executable at
+# bucket n64_e96 / n_p=4 must land within CALIBRATION_BAND of the
+# measured serve.phase.device p95 at the reference RPS
+# (runs/bench_serve_load_r10.json: 13.03 ms; the model says ~10.9 ms).
+# A TPU deployment passes its chip's real numbers via CostSpec.
+PEAK_FLOPS_DEFAULT = 4.0e11          # sustained FLOP/s
+HBM_BW_DEFAULT = 4.0e11              # sustained bytes/s
+DISPATCH_OVERHEAD_S_DEFAULT = 5.0e-4  # per-executable dispatch cost
+
+# Predicted-vs-measured ratio the bench_report calibration gate
+# tolerates (either direction).  The static model is a fusion-blind
+# ceiling driven by worst-case trip counts, so it will not be exact —
+# but drifting past 4x in either direction means the priors feeding
+# the shaper/scheduler have come unmoored from the hardware.
+CALIBRATION_BAND = 4.0
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineModel:
+    """Roofline envelope: time = max(compute, traffic) + dispatch."""
+
+    peak_flops: float = PEAK_FLOPS_DEFAULT
+    hbm_bytes_per_s: float = HBM_BW_DEFAULT
+    dispatch_overhead_s: float = DISPATCH_OVERHEAD_S_DEFAULT
+
+    def est_device_s(self, flops: float, hbm_bytes: float) -> float:
+        return max(flops / self.peak_flops,
+                   hbm_bytes / self.hbm_bytes_per_s) \
+            + self.dispatch_overhead_s
+
+
+# --------------------------------------------------------------------
+# The posture (COST_SPEC fixture mode).
+# --------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class CostSpec:
+    """One serving posture priced by the cost pass.  Surface bounds
+    mirror ``serve.server.ServeConfig`` admission defaults (same as
+    footprint.SurfaceSpec, pinned by tests)."""
+
+    max_nodes: int = 1 << 20
+    max_edges: int = 1 << 22
+    max_batch: int = 8
+    n_p: int = 20                      # ConsensusConfig default
+    algorithm: str = "louvain"
+    waste_budget: float = WASTE_BUDGET_DEFAULT
+    duality_min_saving: float = DUALITY_MIN_SAVING_DEFAULT
+    regress_frac: float = REGRESS_FRAC_DEFAULT
+    frontier_series: Tuple[float, ...] = FRONTIER_SERIES_DEFAULT
+    # Fixture-mode roofline baseline: {"kind@bucket" or
+    # "kind@bucket:b": est_device_s} — cost-roofline-regress compares
+    # the mirror against these (the history twin compares committed
+    # artifacts instead).
+    baseline: Optional[Dict[str, float]] = None
+    peak_flops: float = PEAK_FLOPS_DEFAULT
+    hbm_bytes_per_s: float = HBM_BW_DEFAULT
+    dispatch_overhead_s: float = DISPATCH_OVERHEAD_S_DEFAULT
+    # Restrict evaluation to these rules (fixture mode; None = all).
+    rules: Optional[Tuple[str, ...]] = None
+    origin: str = "<defaults>"
+    origin_line: int = 0
+
+    _KEYS = ("max_nodes", "max_edges", "max_batch", "n_p", "algorithm",
+             "waste_budget", "duality_min_saving", "regress_frac",
+             "frontier_series", "baseline", "peak_flops",
+             "hbm_bytes_per_s", "dispatch_overhead_s", "rules")
+
+    @classmethod
+    def from_mapping(cls, d: Dict, origin: str = "<spec>",
+                     origin_line: int = 0) -> "CostSpec":
+        unknown = set(d) - set(cls._KEYS)
+        if unknown:
+            raise ValueError(
+                f"{origin}: unknown COST_SPEC key(s) "
+                f"{sorted(unknown)}; known: {list(cls._KEYS)}")
+        kw = dict(d)
+        for k in ("frontier_series", "rules"):
+            if kw.get(k) is not None:
+                kw[k] = tuple(kw[k])
+        if kw.get("baseline") is not None and \
+                not isinstance(kw["baseline"], dict):
+            raise ValueError(
+                f"{origin}: COST_SPEC baseline must be a dict of "
+                f"'kind@bucket' -> est_device_s")
+        if kw.get("rules"):
+            bad = set(kw["rules"]) - set(COST_RULES)
+            if bad:
+                raise ValueError(
+                    f"{origin}: COST_SPEC rules {sorted(bad)} are "
+                    f"not cost rules {list(COST_RULES)}")
+        return cls(origin=origin, origin_line=origin_line, **kw)
+
+    def wants(self, rule: str) -> bool:
+        return self.rules is None or rule in self.rules
+
+    def machine(self) -> MachineModel:
+        return MachineModel(self.peak_flops, self.hbm_bytes_per_s,
+                            self.dispatch_overhead_s)
+
+    def surface(self) -> SurfaceSpec:
+        """The footprint-side view of this posture (grid enumeration
+        helpers are shared, not re-mirrored)."""
+        return SurfaceSpec(max_nodes=self.max_nodes,
+                           max_edges=self.max_edges,
+                           max_batch=self.max_batch, n_p=self.n_p,
+                           algorithm=self.algorithm)
+
+
+def find_specs(paths: Iterable[str]) -> List[CostSpec]:
+    """Module-level ``COST_SPEC = {...}`` literals in the scanned
+    sources (fixture mode).  Non-literal or unknown-key specs raise
+    ValueError — a typo'd fixture must not silently evaluate defaults.
+    """
+    import ast
+    import os
+
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", "build"))
+                files.extend(os.path.join(root, f) for f in sorted(names)
+                             if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    specs: List[CostSpec] = []
+    for f in files:
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=f)
+        # fcheck: ok=swallowed-error (unreadable/unparsable
+        # files are astlint's finding; the spec scan skips them)
+        except (OSError, SyntaxError):
+            continue
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "COST_SPEC"
+                    for t in node.targets):
+                d = ast.literal_eval(node.value)   # ValueError on junk
+                if not isinstance(d, dict):
+                    raise ValueError(
+                        f"{f}:{node.lineno}: COST_SPEC must be a "
+                        f"dict literal")
+                specs.append(CostSpec.from_mapping(
+                    d, origin=f, origin_line=node.lineno))
+    return specs
+
+
+# --------------------------------------------------------------------
+# The eqn-level visitor (needs a traced jaxpr; never imports jax
+# itself — footprint._aval_bytes handles the dtype arithmetic).
+# --------------------------------------------------------------------
+
+# Pure data movement: priced in bytes only (a copy is traffic, not
+# arithmetic).  gather rides here — its cost is the indexed traffic.
+_MOVEMENT_PRIMS = frozenset({
+    "reshape", "broadcast_in_dim", "transpose", "convert_element_type",
+    "squeeze", "expand_dims", "slice", "dynamic_slice",
+    "dynamic_update_slice", "concatenate", "pad", "rev", "gather",
+    "iota", "copy", "stop_gradient", "select_n", "bitcast_convert_type",
+    "device_put", "real", "imag",
+})
+
+_CALL_JAXPR_PRIMS = ("pjit", "closed_call", "core_call", "custom_jvp_call",
+                     "custom_vjp_call", "remat", "checkpoint", "xla_call")
+
+
+def _nelems(aval) -> int:
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    return n
+
+
+def _io_bytes(eqn) -> int:
+    total = 0
+    for v in list(eqn.invars) + list(eqn.outvars):
+        aval = getattr(v, "aval", None)
+        if aval is not None:
+            total += _aval_bytes(aval)
+    return total
+
+
+def _sub_jaxpr_params(eqn) -> List:
+    subs = []
+    for v in eqn.params.values():
+        if hasattr(v, "eqns") or hasattr(v, "jaxpr"):
+            subs.append(v)
+        elif isinstance(v, (tuple, list)):
+            subs.extend(el for el in v
+                        if hasattr(el, "eqns") or hasattr(el, "jaxpr"))
+    return subs
+
+
+def eqn_cost(jaxpr, while_bound: int = MAX_SWEEPS) -> Dict[str, float]:
+    """FLOPs + HBM byte traffic of a traced (closed) jaxpr.
+
+    Counting rules (a conservative ceiling, like peak_live_bytes):
+
+    * ``dot_general``: 2 * output elements * contracted extent (MACs
+      count as two ops, the roofline convention).
+    * scatter family: one combine op per update element.
+    * movement primitives: bytes only.
+    * everything else: one op per output element (elementwise model).
+    * bytes: operand + result bytes of every equation — fusion-blind
+      by design (XLA fusion only ever lowers true traffic).
+    * ``while``: cond + body x ``while_bound`` (the sweep budget —
+      data-dependent trips cannot be known statically, so the model
+      prices the budget the kernel itself enforces); ``scan``: body x
+      ``length``; ``cond``: the max-cost branch; call primitives: the
+      sum of their sub-jaxprs.
+    """
+    inner = getattr(jaxpr, "jaxpr", jaxpr)
+    flops = 0.0
+    hbm = 0.0
+    for eqn in inner.eqns:
+        name = eqn.primitive.name
+        if name == "while":
+            cf = eqn_cost(eqn.params["cond_jaxpr"], while_bound)
+            bf = eqn_cost(eqn.params["body_jaxpr"], while_bound)
+            flops += while_bound * (cf["flops"] + bf["flops"])
+            hbm += while_bound * (cf["hbm_bytes"] + bf["hbm_bytes"])
+        elif name == "cond":
+            branches = [eqn_cost(br, while_bound)
+                        for br in eqn.params["branches"]]
+            flops += max(b["flops"] for b in branches)
+            hbm += max(b["hbm_bytes"] for b in branches)
+        elif name == "scan":
+            body = eqn_cost(eqn.params["jaxpr"], while_bound)
+            length = int(eqn.params.get("length", 1))
+            flops += length * body["flops"]
+            hbm += length * body["hbm_bytes"]
+        elif name == "dot_general":
+            (lhs_c, _), _ = eqn.params["dimension_numbers"]
+            lhs = eqn.invars[0].aval
+            k = 1
+            for d in lhs_c:
+                k *= int(lhs.shape[d])
+            flops += 2.0 * _nelems(eqn.outvars[0].aval) * k
+            hbm += _io_bytes(eqn)
+        elif name.startswith("scatter"):
+            flops += float(_nelems(eqn.invars[-1].aval))
+            hbm += _io_bytes(eqn)
+        elif name in _MOVEMENT_PRIMS:
+            hbm += _io_bytes(eqn)
+        else:
+            subs = _sub_jaxpr_params(eqn)
+            if subs:
+                for sub in subs:
+                    c = eqn_cost(sub, while_bound)
+                    flops += c["flops"]
+                    hbm += c["hbm_bytes"]
+            else:
+                flops += float(sum(_nelems(v.aval)
+                                   for v in eqn.outvars))
+                hbm += _io_bytes(eqn)
+    return {"flops": flops, "hbm_bytes": hbm}
+
+
+def _trace_cost(kind: str, n_class: int, e_class: int, b: int, mode: str,
+                spec: CostSpec) -> Dict[str, float]:
+    """Trace one surface executable and run the visitor.  Memoized per
+    process alongside the footprint trace cache (same entry points)."""
+    key = (kind, n_class, e_class, b, mode, spec.n_p, spec.algorithm)
+    try:
+        return _COST_CACHE[key]
+    # fcheck: ok=swallowed-error (cache miss, not an error:
+    # the trace below fills the entry)
+    except KeyError:
+        pass
+    import logging
+
+    from fastconsensus_tpu.analysis import entrypoints as eps
+
+    logger = logging.getLogger("fastconsensus_tpu")
+    level = logger.level
+    logger.setLevel(logging.ERROR)   # hash-cap warnings are expected at
+    try:                             # frontier shapes; keep CI logs clean
+        closed = eps.trace_serving_executable(
+            kind, n_class, e_class, b=b, mode=mode, n_p=spec.n_p,
+            algorithm=spec.algorithm)
+    finally:
+        logger.setLevel(level)
+    res = eqn_cost(closed)
+    _COST_CACHE[key] = res
+    return res
+
+
+_COST_CACHE: Dict[Tuple, Dict[str, float]] = {}
+
+
+# --------------------------------------------------------------------
+# The jax-free ladder mirror.
+# --------------------------------------------------------------------
+#
+# Closed-form fits of the visitor over the bucket ladder, per kind and
+# detection-path regime (matmul: n <= MATMUL_MAX_N; hash above), each
+# linear in ensemble width n_p and batch rung b.  The MAX_SWEEPS^2
+# nested sweep bound is baked into the coefficients (the rounds block
+# nests the local-move sweep loop inside the convergence loop).
+# Coefficients are least-squares fits of the traced visitor at ladder
+# buckets; tests/test_cost.py pins traced/mirror inside a ratio band.
+
+# rounds block, matmul regime: per-n_p flops ~ c3*n^3 + c2*n^2 + ce*e
+_ROUNDS_MM_F = (2046.0, 19500.0, 1600.0)
+# ...bytes ~ d2*n^2 + dn*n + de*e
+_ROUNDS_MM_B = (2.37e5, 6.0e5, 2.55e5)
+# rounds block, hash regime: per-n_p flops ~ fn*n + fe*e
+_ROUNDS_HASH_F = (9.1e4, 3.48e5)
+_ROUNDS_HASH_B = (1.32e6, 6.54e6)
+# final detect, matmul regime: per-n_p flops ~ c3*n^3 + c2*n^2
+_DETECT_MM_F = (64.0, 610.0)
+_DETECT_MM_B = (7400.0, 2.5e4)
+_DETECT_HASH_F = (2.4e3, 1.1e4)
+_DETECT_HASH_B = (4.5e4, 2.0e5)
+# tail merge: ~ (n + e) with a weak ensemble-width term
+_TAIL_F = 300.0
+_TAIL_B = 6000.0
+
+
+def _mirror_rounds(n: int, e: int) -> Tuple[float, float]:
+    if n <= MATMUL_MAX_N:
+        c3, c2, ce = _ROUNDS_MM_F
+        d2, dn, de = _ROUNDS_MM_B
+        return (c3 * n ** 3 + c2 * n ** 2 + ce * e,
+                d2 * n ** 2 + dn * n + de * e)
+    fn, fe = _ROUNDS_HASH_F
+    bn, be = _ROUNDS_HASH_B
+    return (fn * n + fe * e, bn * n + be * e)
+
+
+def _mirror_detect(n: int, e: int) -> Tuple[float, float]:
+    if n <= MATMUL_MAX_N:
+        c3, c2 = _DETECT_MM_F
+        d2, dn = _DETECT_MM_B
+        return (c3 * n ** 3 + c2 * n ** 2, d2 * n ** 2 + dn * n)
+    fn, fe = _DETECT_HASH_F
+    bn, be = _DETECT_HASH_B
+    return (fn * n + fe * e, bn * n + be * e)
+
+
+def mirror_cost(kind: str, n_class: int, e_class: int, b: int = 1,
+                n_p: int = 20) -> Dict[str, float]:
+    """Jax-free {flops, hbm_bytes} for one surface executable.  ``kind``
+    accepts the surface vocabulary with or without the ``[mode]``
+    suffix — warm/cold/scratch share one traced program, so the mode
+    never changes the modeled cost (compile time is not priced here).
+    """
+    base = kind.split("[", 1)[0]
+    n, e = int(n_class), int(e_class)
+    npp, bb = max(int(n_p), 1), max(int(b), 1)
+    if base in ("rounds", "batch"):
+        f, by = _mirror_rounds(n, e)
+        return {"flops": f * npp * bb, "hbm_bytes": by * npp * bb}
+    if base in ("detect", "detect-batch"):
+        f, by = _mirror_detect(n, e)
+        return {"flops": f * npp * bb, "hbm_bytes": by * npp * bb}
+    if base == "tail":
+        scale = (n + e) * (1.0 + npp / 16.0)
+        return {"flops": _TAIL_F * scale, "hbm_bytes": _TAIL_B * scale}
+    raise ValueError(f"unknown surface kind {kind!r}")
+
+
+def mirror_est_s(kind: str, n_class: int, e_class: int, b: int = 1,
+                 n_p: int = 20,
+                 machine: Optional[MachineModel] = None) -> float:
+    """Jax-free roofline seconds for one surface executable."""
+    m = machine or MachineModel()
+    c = mirror_cost(kind, n_class, e_class, b=b, n_p=n_p)
+    return m.est_device_s(c["flops"], c["hbm_bytes"])
+
+
+# --------------------------------------------------------------------
+# Runtime feedback: static priors for the shaper / scheduler.
+# --------------------------------------------------------------------
+
+_BUCKET_KEY_RE = re.compile(r"^n(\d+)_e(\d+)$")
+
+# One backlog unit for the spill weighting = a job this long.  The
+# scheduler's spill_backlog counts jobs; weighting by
+# est_device_s/unit makes a queued 100 s bucket weigh its true drain
+# time while sub-second buckets keep weight 1.0 (identical routing to
+# the unweighted era — pinned by the fcpool CI smoke).
+SPILL_COST_UNIT_S = 1.0
+SPILL_WEIGHT_MAX = 16.0
+
+
+def parse_bucket_key(bucket_key: str) -> Optional[Tuple[int, int]]:
+    """``"n64_e96" -> (64, 96)``; None for anything unparseable (batch
+    group keys, mesh-tier tags — callers fall back to history-only)."""
+    m = _BUCKET_KEY_RE.match(str(bucket_key or ""))
+    if not m:
+        return None
+    return int(m.group(1)), int(m.group(2))
+
+
+def static_service_prior(bucket_key: str, n_p: int = 20,
+                         algorithm: str = "louvain",
+                         machine: Optional[MachineModel] = None
+                         ) -> Optional[float]:
+    """Cold-start device-seconds prior for one bucket: the mirrored
+    roofline estimate of the solo rounds executable (the executable a
+    cold bucket's first job runs).  Jax-free and pure arithmetic —
+    safe on every admission path.  None when the key is not a ladder
+    bucket.  ``algorithm`` is accepted for signature parity with the
+    estimator it seeds; the mirror prices the louvain-family surface
+    either way (lpm executables are strictly cheaper — the prior stays
+    a ceiling).
+    """
+    parsed = parse_bucket_key(bucket_key)
+    if parsed is None:
+        return None
+    n, e = parsed
+    return mirror_est_s("rounds", n, e, b=1, n_p=n_p, machine=machine)
+
+
+def spill_weight(bucket_key: str, n_p: int = 20) -> float:
+    """StickyScheduler backlog weight: queued jobs of this bucket count
+    as ``est_device_s / SPILL_COST_UNIT_S`` backlog units each, clamped
+    to [1, SPILL_WEIGHT_MAX] — sub-unit buckets route exactly as the
+    unweighted era did; a bucket whose jobs run for minutes spills off
+    a busy home after a single queued job instead of serializing."""
+    prior = static_service_prior(bucket_key, n_p=n_p)
+    if prior is None:
+        return 1.0
+    return min(max(prior / SPILL_COST_UNIT_S, 1.0), SPILL_WEIGHT_MAX)
+
+
+# --------------------------------------------------------------------
+# The rules (all jax-free via the mirror).
+# --------------------------------------------------------------------
+
+
+def dead_compute_bill(spec: CostSpec) -> Dict:
+    """The frontier dead-compute bill: per round, the fraction of the
+    rounds executable's FLOPs spent on vertices the committed fcqual
+    frontier series says have already left the active set (assuming
+    vertex-proportional round cost — the vertex-parallel formulation's
+    premise).  Priced at the lfr1k posture the series was measured on.
+    """
+    n, e = DEAD_BUCKET_DEFAULT
+    n = grid_up(min(n, spec.max_nodes), MIN_NODE_CLASS)
+    e = grid_up(min(e, spec.max_edges), MIN_NODE_CLASS)
+    n_p = DEAD_N_P_DEFAULT
+    round_cost = mirror_cost("rounds", n, e, b=1, n_p=n_p)
+    round_flops = round_cost["flops"]
+    series = [float(f) for f in spec.frontier_series]
+    per_round = []
+    for i, frac in enumerate(series):
+        dead = max(0.0, min(1.0, 1.0 - frac))
+        per_round.append({
+            "round": i + 1,
+            "frontier_frac": round(frac, 6),
+            "dead_frac": round(dead, 6),
+            "dead_flops": int(round_flops * dead),
+        })
+    dead_fracs = [r["dead_frac"] for r in per_round]
+    run_dead = sum(dead_fracs) / len(dead_fracs) if dead_fracs else 0.0
+    late = dead_fracs[len(dead_fracs) // 2:] or [0.0]
+    return {
+        "bucket": f"n{n}_e{e}",
+        "n_p": n_p,
+        "rounds": len(series),
+        "round_flops": int(round_flops),
+        "per_round": per_round,
+        "run_dead_frac": round(run_dead, 6),
+        "late_round_dead_frac": round(sum(late) / len(late), 6),
+        "waste_budget": spec.waste_budget,
+    }
+
+
+def check_dead_compute(spec: CostSpec) -> Tuple[List[Diagnostic], Dict]:
+    bill = dead_compute_bill(spec)
+    diags: List[Diagnostic] = []
+    if bill["run_dead_frac"] > spec.waste_budget:
+        diags.append(Diagnostic(
+            rule="cost-dead-compute",
+            message=(
+                f"frontier dead-compute bill: {bill['run_dead_frac']:.2f}"
+                f" of the run's rounds-executable FLOPs at "
+                f"{bill['bucket']} go to vertices the measured frontier "
+                f"series has already frozen (late rounds "
+                f"{bill['late_round_dead_frac']:.2f}), over the "
+                f"{spec.waste_budget:.2f} waste budget "
+                f"(--waste-budget): land the frontier mask or re-pin "
+                f"the budget with the quantified bill"),
+            file=spec.origin, line=spec.origin_line))
+    return diags, bill
+
+
+def _rep_buckets(spec: CostSpec) -> List[Tuple[int, int]]:
+    """Representative buckets the duality table and the traced gate
+    price: the ladder floor, the matmul-regime top (the detection-path
+    flip), and a hash-regime bucket — clamped to the posture."""
+    surface = spec.surface()
+    cands = [(MIN_NODE_CLASS, grid_up(96, MIN_NODE_CLASS)),
+             (MATMUL_MAX_N, grid_up(3 * MATMUL_MAX_N // 2,
+                                    MIN_NODE_CLASS)),
+             (4 * MATMUL_MAX_N, grid_up(8 * MATMUL_MAX_N,
+                                        MIN_NODE_CLASS))]
+    out = []
+    for n, e in cands:
+        n = grid_up(min(n, spec.max_nodes), MIN_NODE_CLASS)
+        e = grid_up(min(e, spec.max_edges), MIN_NODE_CLASS)
+        if reachable(n, e, surface) and (n, e) not in out:
+            out.append((n, e))
+    return out
+
+
+def duality_table(spec: CostSpec) -> List[Dict]:
+    """Per representative bucket at the top batch rung: the solo
+    executable, the batched executable, and the per-job saving the
+    rung buys (dispatch amortization under the roofline).  This is the
+    price sheet of the solo/batch engine duality — what the
+    batched-first refactor collapses to one path."""
+    machine = spec.machine()
+    top = batch_rungs(spec.max_batch)[-1]
+    rows: List[Dict] = []
+    for n, e in _rep_buckets(spec):
+        solo = mirror_est_s("rounds", n, e, b=1, n_p=spec.n_p,
+                            machine=machine)
+        if top > 1:
+            batch = mirror_est_s("batch", n, e, b=top, n_p=spec.n_p,
+                                 machine=machine)
+        else:
+            batch = solo
+        per_job = batch / max(top, 1)
+        saving = 1.0 - per_job / solo if solo > 0 else 0.0
+        rows.append({
+            "bucket": f"n{n}_e{e}",
+            "batch": top,
+            "solo_est_s": round(solo, 9),
+            "batch_est_s": round(batch, 9),
+            "per_job_est_s": round(per_job, 9),
+            "per_job_saving_frac": round(saving, 6),
+        })
+    return rows
+
+
+def check_duality(spec: CostSpec) -> Tuple[List[Diagnostic], List[Dict]]:
+    rows = duality_table(spec)
+    diags: List[Diagnostic] = []
+    for row in rows:
+        if row["per_job_saving_frac"] < spec.duality_min_saving:
+            diags.append(Diagnostic(
+                rule="cost-duality",
+                message=(
+                    f"solo/batch duality at {row['bucket']}: the "
+                    f"B={row['batch']} rung saves "
+                    f"{row['per_job_saving_frac']:.3f} per job over "
+                    f"solo dispatch ({row['per_job_est_s']:.6f}s vs "
+                    f"{row['solo_est_s']:.6f}s), under the "
+                    f"{spec.duality_min_saving:.3f} floor — the "
+                    f"two-path surface costs more than it returns "
+                    f"here"),
+                file=spec.origin, line=spec.origin_line))
+            break   # one finding prices the posture; rows carry the rest
+    return diags, rows
+
+
+_BASELINE_KEY_RE = re.compile(
+    r"^(?P<kind>[a-z-]+(?:\[[a-z]+\])?)@n(?P<n>\d+)_e(?P<e>\d+)"
+    r"(?::(?P<b>\d+))?$")
+
+
+def check_regress(spec: CostSpec) -> List[Diagnostic]:
+    """Fixture-mode roofline regression: mirror estimates vs the
+    spec's committed baseline map.  (The committed-artifact twin is
+    obs/history.check_costs.)"""
+    if not spec.baseline:
+        return []
+    machine = spec.machine()
+    diags: List[Diagnostic] = []
+    for key in sorted(spec.baseline):
+        m = _BASELINE_KEY_RE.match(key)
+        if not m:
+            raise ValueError(
+                f"{spec.origin}: COST_SPEC baseline key {key!r} is not "
+                f"'kind@n<N>_e<E>[:b]'")
+        base_s = float(spec.baseline[key])
+        b = int(m.group("b") or 1)
+        est = mirror_est_s(m.group("kind"), int(m.group("n")),
+                           int(m.group("e")), b=b, n_p=spec.n_p,
+                           machine=machine)
+        if base_s > 0 and est > base_s * (1.0 + spec.regress_frac):
+            diags.append(Diagnostic(
+                rule="cost-roofline-regress",
+                message=(
+                    f"roofline regression at {key}: modeled "
+                    f"est_device_s {est:.6f}s is "
+                    f"{est / base_s:.2f}x the committed baseline "
+                    f"{base_s:.6f}s (tolerance "
+                    f"+{spec.regress_frac:.0%}); re-baseline only "
+                    f"with the perf change that justifies it"),
+                file=spec.origin, line=spec.origin_line))
+    return diags
+
+
+# --------------------------------------------------------------------
+# Traced tables (full package scans only).
+# --------------------------------------------------------------------
+
+
+def _gate_row(kind_label: str, kind: str, n: int, e: int, b: int,
+              mode: str, spec: CostSpec,
+              machine: MachineModel) -> Dict:
+    c = _trace_cost(kind, n, e, b, mode, spec)
+    flops, hbm = c["flops"], c["hbm_bytes"]
+    return {
+        "kind": kind_label,
+        "bucket": f"n{n}_e{e}",
+        "batch": b,
+        "flops": int(flops),
+        "hbm_bytes": int(hbm),
+        "arith_intensity": round(flops / hbm, 6) if hbm else None,
+        "est_device_s": round(machine.est_device_s(flops, hbm), 9),
+    }
+
+
+def gate_table(spec: CostSpec) -> List[Dict]:
+    """Traced cost rows for all 16 executable kinds per representative
+    bucket (4 solo + 4 per batch rung > 1 — the footprint surface
+    vocabulary).  Warm/cold/scratch share one traced program, so each
+    mode row re-prices the same trace: the duplication is deliberate —
+    the artifact enumerates the surface the engine actually compiles.
+    """
+    machine = spec.machine()
+    rows: List[Dict] = []
+    for n, e in _rep_buckets(spec):
+        solo = _trace_cost("rounds", n, e, 1, "warm", spec)
+        for mode in ("warm", "scratch"):
+            rows.append(_gate_row(f"rounds[{mode}]", "rounds", n, e, 1,
+                                  "warm", spec, machine))
+        del solo
+        rows.append(_gate_row("tail", "tail", n, e, 1, "-", spec,
+                              machine))
+        rows.append(_gate_row("detect", "detect", n, e, 1, "-", spec,
+                              machine))
+        for rung in batch_rungs(spec.max_batch):
+            if rung <= 1:
+                continue
+            for mode in ("warm", "cold", "scratch"):
+                rows.append(_gate_row(f"batch[{mode}]", "batch", n, e,
+                                      rung, "warm", spec, machine))
+            rows.append(_gate_row("detect-batch", "detect-batch", n, e,
+                                  rung, "-", spec, machine))
+    return rows
+
+
+def cost_table(spec: CostSpec, max_rows: int = 12) -> List[Dict]:
+    """The mirror's per-bucket cost table (the artifact ``buckets``
+    block): the e-spine sampled at power-of-two classes plus floor and
+    top, each at its densest-connected node class, solo rounds."""
+    machine = spec.machine()
+    surface = spec.surface()
+    es = edge_classes(surface)
+    spine = [e for e in es if e & (e - 1) == 0]
+    for must in (es[0], es[-1]):
+        if must not in spine:
+            spine.append(must)
+    spine = sorted(set(spine))
+    if len(spine) > max_rows:
+        idx = {0, len(spine) - 1}
+        step = (len(spine) - 1) / (max_rows - 1)
+        idx |= {round(i * step) for i in range(max_rows)}
+        spine = [spine[i] for i in sorted(idx)]
+    rows: List[Dict] = []
+    for e_class in spine:
+        n_class = grid_up(min(2 * e_class, spec.max_nodes),
+                          MIN_NODE_CLASS)
+        if not reachable(n_class, e_class, surface):
+            continue
+        c = mirror_cost("rounds", n_class, e_class, b=1, n_p=spec.n_p)
+        flops, hbm = c["flops"], c["hbm_bytes"]
+        rows.append({
+            "bucket": f"n{n_class}_e{e_class}",
+            "n_class": n_class, "e_class": e_class,
+            "flops": int(flops),
+            "hbm_bytes": int(hbm),
+            "arith_intensity": round(flops / hbm, 6) if hbm else None,
+            "est_device_s": round(
+                machine.est_device_s(flops, hbm), 9),
+        })
+    return rows
+
+
+# The serve_load reference posture the calibration block prices: the
+# committed runs/bench_serve_load_rNN.json history drives karate-sized
+# jobs (bucket n64_e96, louvain, n_p=4) and records the measured
+# serve.phase.device tail per point — obs/history.check_cost_calibration
+# compares this block against it within CALIBRATION_BAND.
+CALIBRATION_BUCKET = (64, 96)
+CALIBRATION_N_P = 4
+
+
+def calibration_block(spec: CostSpec) -> Dict:
+    """Traced predicted-device-time block for the serve_load reference
+    posture (see CALIBRATION_BUCKET)."""
+    n, e = CALIBRATION_BUCKET
+    cal_spec = dataclasses.replace(spec, n_p=CALIBRATION_N_P)
+    c = _trace_cost("rounds", n, e, 1, "warm", cal_spec)
+    est = spec.machine().est_device_s(c["flops"], c["hbm_bytes"])
+    return {
+        "bucket": f"n{n}_e{e}",
+        "n_p": CALIBRATION_N_P,
+        "kind": "rounds[warm]",
+        "est_device_ms": round(est * 1000.0, 3),
+        "band": CALIBRATION_BAND,
+    }
+
+
+# --------------------------------------------------------------------
+# Orchestration (what __main__ calls).
+# --------------------------------------------------------------------
+
+
+def evaluate(spec: CostSpec, rules: Optional[Iterable[str]] = None,
+             with_table: bool = False
+             ) -> Tuple[List[Diagnostic], Dict]:
+    """Run the selected cost rules against one posture; returns
+    (diagnostics, cost report block — see the module docstring
+    schema).  The rules are mirror-only (never import jax);
+    ``with_table`` adds the traced gate/calibration blocks (full
+    package scans — the CLI pays the traces exactly where footprint
+    pays its table)."""
+    selected = set(rules) if rules is not None else set(COST_RULES)
+    selected &= {r for r in COST_RULES if spec.wants(r)}
+    diags: List[Diagnostic] = []
+    block: Dict = {
+        "tool": "fcheck-cost",
+        "version": 1,
+        "config": {
+            "max_nodes": spec.max_nodes, "max_edges": spec.max_edges,
+            "max_batch": spec.max_batch, "n_p": spec.n_p,
+            "algorithm": spec.algorithm,
+            "waste_budget": spec.waste_budget,
+            "duality_min_saving": spec.duality_min_saving,
+            "regress_frac": spec.regress_frac,
+            "peak_flops": spec.peak_flops,
+            "hbm_bytes_per_s": spec.hbm_bytes_per_s,
+            "dispatch_overhead_s": spec.dispatch_overhead_s,
+        },
+        "frontier_series": [round(float(f), 6)
+                            for f in spec.frontier_series],
+        "dead_compute": None,
+        "duality": [],
+        "gate": [],
+        "buckets": [],
+        "calibration": None,
+    }
+    if "cost-dead-compute" in selected:
+        dead_diags, bill = check_dead_compute(spec)
+        diags.extend(dead_diags)
+        block["dead_compute"] = bill
+    if "cost-duality" in selected:
+        dual_diags, rows = check_duality(spec)
+        diags.extend(dual_diags)
+        block["duality"] = rows
+    if "cost-roofline-regress" in selected:
+        diags.extend(check_regress(spec))
+    if with_table:
+        block["gate"] = gate_table(spec)
+        block["buckets"] = cost_table(spec)
+        block["calibration"] = calibration_block(spec)
+    return diags, block

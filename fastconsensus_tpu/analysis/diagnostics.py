@@ -110,6 +110,9 @@ class Report:
     # the footprint block (analysis/footprint.py module docstring
     # documents the schema); None when the footprint pass did not run
     footprint: Optional[dict] = None
+    # the compute-cost block (analysis/cost.py module docstring
+    # documents the schema); None when the cost pass did not run
+    cost: Optional[dict] = None
 
     def extend(self, diags: List[Diagnostic]) -> None:
         self.diagnostics.extend(diags)
@@ -130,6 +133,7 @@ class Report:
             "diagnostics": [d.to_dict() for d in self.diagnostics],
             "jaxpr_entry_points": self.jaxpr_summary,
             "footprint": self.footprint,
+            "cost": self.cost,
         }, indent=2, sort_keys=True)
 
     def format_human(self) -> str:
@@ -149,4 +153,15 @@ class Report:
                 f"max pad {fp.get('max_pad_frac'):.0%}, "
                 f"chip ceiling "
                 f"{ceil if ceil is not None else 'n/a'} edges")
+        if self.cost is not None:
+            dc = self.cost.get("dead_compute") or {}
+            cal = self.cost.get("calibration") or {}
+            lines.append(
+                f"fcheck-cost: dead-compute "
+                f"{dc.get('run_dead_frac', 0.0):.0%} of run FLOPs at "
+                f"{dc.get('bucket', 'n/a')} "
+                f"(budget {dc.get('waste_budget', 0.0):.0%}), "
+                f"{len(self.cost.get('gate') or [])} gate row(s), "
+                f"calibration "
+                f"{cal.get('est_device_ms', 'n/a')} ms device est")
         return "\n".join(lines)

@@ -128,9 +128,12 @@ def audit_entry_points(names: Optional[List[str]] = None,
     analog of primitive-count drift), and with ``hbm_bytes`` set any
     entry point modeling past the budget is a ``jaxpr-peak-bytes``
     finding (the serving-surface gate at real bucket shapes lives in
-    the footprint pass).
+    the footprint pass) — plus its cost-visitor ``flops`` and
+    ``arith_intensity`` (flops per HBM byte; analysis/cost.py), so one
+    table answers both "will it fit" and "what will it cost".
     """
     from fastconsensus_tpu.analysis import entrypoints as eps
+    from fastconsensus_tpu.analysis.cost import eqn_cost
     from fastconsensus_tpu.analysis.footprint import peak_live_bytes
 
     diags: List[Diagnostic] = []
@@ -154,6 +157,11 @@ def audit_entry_points(names: Optional[List[str]] = None,
         diags.extend(d)
         peak = peak_live_bytes(closed)["peak"]
         hist["peak_bytes"] = peak
+        cost = eqn_cost(closed)
+        hist["flops"] = int(cost["flops"])
+        hist["arith_intensity"] = round(
+            cost["flops"] / cost["hbm_bytes"], 6) \
+            if cost["hbm_bytes"] else 0.0
         if hbm_bytes is not None and peak > hbm_bytes:
             diags.append(Diagnostic(
                 rule="jaxpr-peak-bytes", file=ep.name,

@@ -141,6 +141,10 @@ def _normalize(rec: dict, source: str, seq: Optional[int]) -> dict:
         "seq": seq,
         "config": _config_key(rec),
         "metric": rec.get("metric"),
+        # the raw bench unit string, kept for matchers that key on the
+        # posture it names (check_cost_calibration greps the serve_load
+        # bucket out of it)
+        "unit": rec.get("unit"),
         "value": float(rec["value"]),
         "vs_baseline": rec.get("vs_baseline"),
         "nmi": rec.get("nmi"),
@@ -743,6 +747,181 @@ def check_footprints(fps: List[dict]) -> List[str]:
         problems.append(
             f"{tag}: surface {newest['surface_count']} exceeds its own "
             f"pinned budget {newest['surface_budget']}")
+    return problems
+
+
+def load_costs(paths: List[str]) -> List[dict]:
+    """fcheck-cost artifacts (``runs/cost_rNN.json`` — the schema
+    analysis/cost.py documents), normalized and ordered by round
+    sequence; files that are not cost artifacts are skipped silently,
+    mirroring :func:`load_footprints`."""
+    out = []
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        # fcheck: ok=swallowed-error (an unreadable cost artifact
+        # simply drops out of the trend gate's window)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(doc, dict) or doc.get("tool") != "fcheck-cost":
+            continue
+        dead = doc.get("dead_compute") or {}
+        out.append({
+            "source": os.path.basename(path),
+            "seq": _seq_from_name(path),
+            "run_dead_frac": dead.get("run_dead_frac"),
+            "late_round_dead_frac": dead.get("late_round_dead_frac"),
+            "waste_budget": dead.get("waste_budget"),
+            "dead_bucket": dead.get("bucket"),
+            "round_flops": dead.get("round_flops"),
+            "duality": doc.get("duality") or [],
+            "gate": doc.get("gate") or [],
+            "calibration": doc.get("calibration"),
+        })
+    out.sort(key=lambda r: (r["seq"] is not None, r["seq"] or 0,
+                            r["source"]))
+    return out
+
+
+def _tflop(v) -> str:
+    return "-" if v is None else f"{v / 1e12:.3f}"
+
+
+def cost_table(costs: List[dict], markdown: bool = False) -> str:
+    """Trend + duality + roofline tables of the static compute-cost
+    model.  Empty string when no cost artifact is committed."""
+    if not costs:
+        return ""
+    lines = _render_rows(
+        "fcheck-cost trend",
+        ["seq", "source", "dead_bucket", "round_tflops", "run_dead",
+         "late_dead", "budget", "cal_est_ms"],
+        [[_fmt(c["seq"]), c["source"], _fmt(c["dead_bucket"]),
+          _tflop(c["round_flops"]), _fmt(c["run_dead_frac"]),
+          _fmt(c["late_round_dead_frac"]), _fmt(c["waste_budget"]),
+          _fmt((c["calibration"] or {}).get("est_device_ms"))]
+         for c in costs],
+        markdown)
+    newest = costs[-1]
+    if newest["duality"]:
+        lines += _render_rows(
+            f"cost duality [{newest['source']}]",
+            ["bucket", "batch", "solo_s", "per_job_s", "saving"],
+            [[d["bucket"], _fmt(d.get("batch")),
+              _fmt(d.get("solo_est_s")), _fmt(d.get("per_job_est_s")),
+              _fmt(d.get("per_job_saving_frac"))]
+             for d in newest["duality"]],
+            markdown)
+    if newest["gate"]:
+        worst = sorted(newest["gate"],
+                       key=lambda r: -(r.get("est_device_s") or 0.0))[:8]
+        lines += _render_rows(
+            f"cost roofline, costliest executables [{newest['source']}]",
+            ["kind", "bucket", "batch", "tflops", "intensity", "est_s"],
+            [[g["kind"], g["bucket"], _fmt(g.get("batch")),
+              _tflop(g.get("flops")), _fmt(g.get("arith_intensity")),
+              _fmt(g.get("est_device_s"))] for g in worst],
+            markdown)
+    return "\n".join(lines).rstrip()
+
+
+# Modeled est_device_s growth per surface kind between consecutive
+# committed cost artifacts that counts as a roofline regression.  Loose
+# enough that mirror-coefficient refits pass, tight enough that an
+# accidental algorithmic blowup (a lost frontier gate reintroducing an
+# n^2 path doubles the estimate and more) always lands outside.
+DEFAULT_COST_GROWTH_FRAC = 0.5
+
+
+def check_costs(costs: List[dict],
+                growth_frac: float = DEFAULT_COST_GROWTH_FRAC
+                ) -> List[str]:
+    """Cost regression findings: per matching (kind, bucket, batch)
+    gate row, the newest sequenced artifact's modeled ``est_device_s``
+    grew beyond ``growth_frac`` over the prior committed one
+    (``cost-roofline-regress``), or the newest dead-compute bill
+    breaches its own pinned waste budget (``cost-dead-compute``)."""
+    problems: List[str] = []
+    seqd = [c for c in costs if c["seq"] is not None]
+    if not seqd:
+        return problems
+    newest = seqd[-1]
+    tag = f"cost [{newest['source']} seq {newest['seq']}]"
+    prior = [c for c in seqd if c["seq"] < newest["seq"]]
+    if prior:
+        base_rows = {(g["kind"], g["bucket"], g.get("batch")):
+                     g.get("est_device_s") for g in prior[-1]["gate"]}
+        for g in newest["gate"]:
+            base = base_rows.get((g["kind"], g["bucket"], g.get("batch")))
+            est = g.get("est_device_s")
+            if not base or est is None:
+                continue
+            if est > base * (1.0 + growth_frac):
+                problems.append(
+                    f"{tag}: cost-roofline-regress: modeled "
+                    f"est_device_s for {g['kind']} at {g['bucket']} "
+                    f"grew {base:.6f}s -> {est:.6f}s "
+                    f"(> +{growth_frac:.0%} vs {prior[-1]['source']}) "
+                    f"— the static surface got costlier; if "
+                    f"deliberate, land the re-baseline with the "
+                    f"change that justifies it")
+    if newest["run_dead_frac"] is not None and \
+            newest["waste_budget"] is not None and \
+            newest["run_dead_frac"] > newest["waste_budget"]:
+        problems.append(
+            f"{tag}: cost-dead-compute: run dead-compute fraction "
+            f"{newest['run_dead_frac']:.2f} breaches the pinned waste "
+            f"budget {newest['waste_budget']:.2f}")
+    return problems
+
+
+def check_cost_calibration(costs: List[dict],
+                           groups: Dict[str, List[dict]]) -> List[str]:
+    """Predicted-vs-measured honesty gate for the static cost model:
+    the newest cost artifact's ``calibration.est_device_ms`` (the
+    modeled device time of the serve_load reference executable) must
+    land within ``calibration.band`` (either direction) of the measured
+    ``serve.phase.device`` tail at the reference RPS of the newest
+    committed serve_load curve on the same bucket.  A model outside the
+    band is feeding the shaper and scheduler priors that no longer
+    describe the hardware."""
+    problems: List[str] = []
+    cal = None
+    for c in costs:
+        if c.get("calibration"):
+            cal = (c, c["calibration"])
+    if cal is None:
+        return problems
+    crec, cblock = cal
+    bucket = str(cblock.get("bucket") or "")
+    est_ms = cblock.get("est_device_ms")
+    band = float(cblock.get("band") or 4.0)
+    if not est_ms or not bucket:
+        return problems
+    measured: List[Tuple[int, str, float]] = []
+    for recs in groups.values():
+        for r in recs:
+            if not r.get("serve_load") or r["seq"] is None:
+                continue
+            if f"bucket {bucket}" not in str(r.get("unit", "")):
+                continue
+            pt = _sl_ref_point(r)
+            dev = ((pt or {}).get("phase_p95_ms") or {}).get("device")
+            if dev:
+                measured.append((r["seq"], r["source"], float(dev)))
+    if not measured:
+        return problems
+    seq, source, dev_ms = max(measured)
+    ratio = max(est_ms / dev_ms, dev_ms / est_ms)
+    if ratio > band:
+        problems.append(
+            f"cost [{crec['source']} seq {crec['seq']}]: calibration "
+            f"drift at {bucket}: modeled device time {est_ms:.1f} ms "
+            f"vs measured serve.phase.device p95 {dev_ms:.1f} ms "
+            f"[{source} seq {seq}] is {ratio:.1f}x apart "
+            f"(band {band:.0f}x) — refit the machine-model constants "
+            f"or find what the model stopped seeing")
     return problems
 
 

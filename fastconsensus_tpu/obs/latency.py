@@ -413,7 +413,9 @@ class LatencyRegistry:
         }
 
     def service_estimate(self, bucket: Optional[str] = None,
-                         min_count: int = 1) -> Optional[Dict[str, Any]]:
+                         min_count: int = 1,
+                         prior: Optional[float] = None
+                         ) -> Optional[Dict[str, Any]]:
         """Measured per-job service time (seconds) derived from the
         existing ``serve.phase.*`` histograms — the traffic shaper's
         (serve/shaping.py) view of how long one job occupies the
@@ -431,7 +433,12 @@ class LatencyRegistry:
         jobs stamp the whole batched call's duration as each member's
         device phase, which also overestimates per-job service — the
         same safe direction.  None until the device phase has
-        ``min_count`` samples.
+        ``min_count`` samples — unless a ``prior`` (static
+        device-seconds estimate, e.g. analysis/cost.py's mirrored
+        roofline for the bucket) is supplied: a history-less bucket
+        then returns ``{"count": 0, "mean_s": prior, "p95_s": prior,
+        "prior": True}`` so cold admission math starts from the model
+        instead of a constant guess.  Measured history always wins.
         """
         with self._lock:
             items = list(self._hists.items())
@@ -452,6 +459,9 @@ class LatencyRegistry:
         merged = {p: merge_snapshots(s) for p, s in per_phase.items()}
         dev = merged.get("device")
         if dev is None or dev["count"] < max(int(min_count), 1):
+            if prior is not None and prior > 0:
+                return {"count": 0, "mean_s": round(float(prior), 9),
+                        "p95_s": round(float(prior), 9), "prior": True}
             return None
         mean = sum(m["sum_s"] / m["count"]
                    for m in merged.values() if m["count"])

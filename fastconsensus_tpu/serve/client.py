@@ -202,9 +202,10 @@ class SloStats:
 class ShapingStats:
     """The ``/metricsz`` ``shaping`` block (serve/shaping.py), typed:
     which control-loop arms are live, the ``serve.shape.*`` counters
-    (holds / bypasses / EDF promotions / deadline sheds), the
-    per-bucket measured service-time estimates the loop decides on,
-    and the Retry-After a 429 issued right now would carry."""
+    (holds / bypasses / EDF promotions / deadline sheds / buckets
+    seeded from the static cost prior), the per-bucket measured
+    service-time estimates the loop decides on, and the Retry-After a
+    429 issued right now would carry."""
 
     edf: bool
     hold: bool
@@ -214,6 +215,7 @@ class ShapingStats:
     bypass: int
     edf_promotions: int
     deadline_sheds: int
+    prior_seeded: int
     estimates: Dict[str, Dict[str, float]]
     retry_after_hint_s: Optional[float]
 
@@ -229,6 +231,7 @@ class ShapingStats:
                    bypass=int(c.get("bypass", 0)),
                    edf_promotions=int(c.get("edf_promotions", 0)),
                    deadline_sheds=int(c.get("deadline_sheds", 0)),
+                   prior_seeded=int(c.get("prior_seeded", 0)),
                    estimates={str(k): dict(v) for k, v in
                               (p.get("estimates") or {}).items()},
                    retry_after_hint_s=p.get("retry_after_hint_s"))

@@ -60,6 +60,18 @@ from fastconsensus_tpu.serve.watchdog import (DISABLED_WATCHDOG,
 _logger = logging.getLogger("fastconsensus_tpu")
 
 
+def _cost_spill_weight():
+    """The scheduler's per-bucket backlog weight from the fcheck-cost
+    jax-free mirror (analysis/cost.py spill_weight), or None when the
+    analyzer cannot load — routing then stays unweighted, never
+    broken."""
+    try:
+        from fastconsensus_tpu.analysis import cost as _cost
+        return _cost.spill_weight
+    except Exception:  # noqa: BLE001 — optional model, mandatory pool
+        return None
+
+
 class _Worker:
     """One device-driving worker thread (base: queueing + lifecycle).
 
@@ -479,7 +491,9 @@ class WorkerPool:
                 "huge_devices reserves a mesh group nothing can reach: "
                 "set chip_max_edges (the single-chip bucket ceiling)")
         self._reg = obs_counters.get_registry()
-        self.scheduler = StickyScheduler(spill_backlog=cfg.spill_backlog)
+        self.scheduler = StickyScheduler(
+            spill_backlog=cfg.spill_backlog,
+            cost_weight=_cost_spill_weight())
         # the LAST huge_devices devices form the reserved mesh group;
         # chip workers take the rest (device ordinal == worker idx ==
         # the fcobs `device=` tag)

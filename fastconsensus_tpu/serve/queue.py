@@ -59,9 +59,12 @@ from fastconsensus_tpu.serve.jobs import Job
 class QueueFull(RuntimeError):
     """Admission refused: the queue is at its depth bound (backpressure,
     not an internal error — HTTP maps it to 429 with a Retry-After
-    derived from the observed service rate when a shaper is present;
-    ``retry_after_s`` stays None otherwise and the handler falls back
-    to the default)."""
+    derived from the observed service rate when a shaper is present; a
+    bucket with no service history yet derives it from the static cost
+    prior the shaper seeds (analysis/cost.py), so even the FIRST 429 a
+    cold bucket ever sends carries model-derived honesty rather than
+    the configured constant.  ``retry_after_s`` stays None only without
+    a shaper, and the handler falls back to the default)."""
 
     retry_after_s: Optional[float] = None
 

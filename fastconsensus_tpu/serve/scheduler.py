@@ -39,7 +39,7 @@ thread updates its residency set).
 from __future__ import annotations
 
 import threading
-from typing import Dict, FrozenSet, Optional, Sequence
+from typing import Callable, Dict, FrozenSet, Optional, Sequence
 
 from fastconsensus_tpu.obs import counters as obs_counters
 from fastconsensus_tpu.obs import flight as obs_flight
@@ -53,15 +53,34 @@ class NoEligibleWorker(RuntimeError):
 class StickyScheduler:
     """Route buckets to workers; see the module docstring."""
 
-    def __init__(self, spill_backlog: int = 8) -> None:
+    def __init__(self, spill_backlog: int = 8,
+                 cost_weight: Optional[
+                     Callable[[str], float]] = None) -> None:
         if spill_backlog < 0:
             raise ValueError(
                 f"spill_backlog must be >= 0, got {spill_backlog}")
         self.spill_backlog = int(spill_backlog)
+        # Per-bucket backlog weight (>= 1.0) from the static cost
+        # model: ``spill_backlog`` counts JOBS, but a queued job of a
+        # minutes-long bucket is not the backlog of a 10 ms one —
+        # weighting the home's load by the bucket's modeled device
+        # seconds (analysis/cost.py spill_weight) makes expensive
+        # buckets spill off a busy home instead of serializing behind
+        # it, while sub-second buckets keep weight 1.0 and route
+        # exactly as before.  None = unweighted (weight 1.0).
+        self._cost_weight = cost_weight
         self._affinity: Dict[str, int] = {}   # bucket key -> worker idx
         self._lock = threading.Lock()
         self._reg = obs_counters.get_registry()
         self._lat = obs_latency.get_latency_registry()
+
+    def _weight(self, bucket: str) -> float:
+        if self._cost_weight is None:
+            return 1.0
+        try:
+            return max(float(self._cost_weight(bucket)), 1.0)
+        except Exception:  # noqa: BLE001 — a broken cost model must
+            return 1.0     # not take down routing
 
     def affinity(self) -> Dict[str, int]:
         """Snapshot of the bucket -> home-device map (``/healthz``)."""
@@ -99,7 +118,9 @@ class StickyScheduler:
             home_idx = self._affinity.get(bucket)
             home = next((w for w in candidates if w.idx == home_idx),
                         None)
-            if home is not None and home.load() <= self.spill_backlog:
+            if home is not None and \
+                    home.load() * self._weight(bucket) \
+                    <= self.spill_backlog:
                 self._reg.inc("serve.sched.sticky_hits")
                 obs_flight.record("route", bucket=bucket,
                                   device=home.idx, via="sticky",

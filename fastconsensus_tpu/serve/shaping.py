@@ -173,6 +173,11 @@ def find_deadline_inversions(pop_log: Iterable[Any]) -> List[str]:
     return problems
 
 
+def _no_prior(bucket: str) -> Optional[float]:
+    """Fallback cost-prior when the analyzer cannot load: no seeding."""
+    return None
+
+
 class TrafficShaper:
     """The shaping control loop shared by queue, admission and HTTP.
 
@@ -188,7 +193,9 @@ class TrafficShaper:
     def __init__(self, config: Optional[ShapingConfig] = None,
                  lat: Optional[obs_latency.LatencyRegistry] = None,
                  reg=None,
-                 parallelism: Optional[Callable[[], int]] = None) -> None:
+                 parallelism: Optional[Callable[[], int]] = None,
+                 cost_prior: Optional[
+                     Callable[[str], Optional[float]]] = None) -> None:
         self.config = config or ShapingConfig()
         self._lat = lat if lat is not None \
             else obs_latency.get_latency_registry()
@@ -197,10 +204,20 @@ class TrafficShaper:
         self._parallelism = parallelism
         self._busy_probe: Optional[Callable[[], bool]] = None
         self._solo_probe: Optional[Callable[[str], bool]] = None
+        # Cold-start device-seconds model: bucket key -> est seconds or
+        # None.  Default resolves lazily to the fcheck-cost jax-free
+        # mirror (analysis/cost.py static_service_prior) on first cold
+        # lookup; tests inject a fake, and ``lambda b: None`` disables
+        # seeding outright.
+        self._cost_prior = cost_prior
         self._lock = threading.Lock()
         # bucket key (or None = all buckets) -> (computed_at, estimate)
         self._est_cache: Dict[Optional[str],
                               Tuple[float, Optional[dict]]] = {}
+        # buckets whose estimate has been prior-seeded at least once
+        # (the serve.shape.prior_seeded counter counts BUCKETS, not
+        # lookups — service_estimate runs on every pop)
+        self._prior_seeded: set = set()
 
     def set_parallelism(self, fn: Callable[[], int]) -> None:
         """Install the live-worker counter (the pool's eligible chip
@@ -268,11 +285,51 @@ class TrafficShaper:
         hold or delays a retry; the shed path passes ``fallback=False``
         because refusing a job on ANOTHER bucket's service time is not
         "provably late".  Cached for :data:`ESTIMATE_TTL_S` because the
-        queue consults it under its condition on every pop."""
+        queue consults it under its condition on every pop.
+
+        A bucket with NO measured history anywhere in the chain is
+        seeded from the static cost prior (the fcheck-cost mirrored
+        roofline): ``{"count": 0, "mean_s": prior, "p95_s": prior,
+        "prior": True}`` — so cold hold bounds, Retry-After and shed
+        math start from the model instead of a constant guess.  Any
+        measured sample beats the model (the prior only fills
+        ``est is None``), and ``retry_after_s`` / ``should_shed``
+        accept a seeded estimate in place of their
+        ``min_estimate_count`` history gate (the ``"prior"`` marker)."""
         est = self._cached_estimate(bucket, now)
         if est is None and fallback and bucket is not None:
             est = self._cached_estimate(None, now)
+        if est is None and bucket is not None:
+            prior = self._static_prior(bucket)
+            if prior is not None and prior > 0:
+                with self._lock:
+                    if bucket not in self._prior_seeded:
+                        self._prior_seeded.add(bucket)
+                        seed_new = True
+                    else:
+                        seed_new = False
+                if seed_new:
+                    self._reg.inc("serve.shape.prior_seeded")
+                est = {"count": 0, "mean_s": round(float(prior), 9),
+                       "p95_s": round(float(prior), 9), "prior": True}
         return est
+
+    def _static_prior(self, bucket: str) -> Optional[float]:
+        fn = self._cost_prior
+        if fn is None:
+            # analysis/cost.py is jax-free by contract (its own
+            # poisoned-jax subprocess test); the import is deferred so
+            # embedded shapers with an injected prior never load it
+            try:
+                from fastconsensus_tpu.analysis import cost as _cost
+                fn = _cost.static_service_prior
+            except Exception:  # noqa: BLE001 — a broken analyzer must
+                fn = _no_prior  # not take down admission
+            self._cost_prior = fn
+        try:
+            return fn(bucket)
+        except Exception:  # noqa: BLE001 — ditto: an unparseable key
+            return None    # just means "no prior"
 
     def _cached_estimate(self, which: Optional[str],
                          now: Optional[float]) -> Optional[dict]:
@@ -357,13 +414,17 @@ class TrafficShaper:
                       bucket: Optional[str] = None) -> float:
         """Seconds until the queue has plausibly drained ``depth``
         jobs: depth x the observed per-job service time over the live
-        worker count.  Falls back to ``retry_after_default_s`` until
-        the estimate has ``min_estimate_count`` samples — an honest
-        guess beats a precise fabrication."""
+        worker count.  Until the estimate has ``min_estimate_count``
+        samples, a prior-seeded estimate (the static cost model — see
+        :meth:`service_estimate`) still derives the answer; only a
+        bucket with neither history nor a prior falls back to
+        ``retry_after_default_s`` — an honest guess beats a precise
+        fabrication."""
         cfg = self.config
         est = self.service_estimate(bucket)
-        if est is None or est["count"] < cfg.min_estimate_count \
-                or not est["mean_s"]:
+        if est is None or not est["mean_s"] or (
+                est["count"] < cfg.min_estimate_count
+                and not est.get("prior")):
             return cfg.retry_after_default_s
         v = max(int(depth), 1) * est["mean_s"] / self._workers()
         return min(max(v, 0.001), cfg.retry_after_max_s)
@@ -388,10 +449,15 @@ class TrafficShaper:
         # per-bucket history ONLY (no cross-bucket fallback): "provably
         # late" judged on another bucket's service time is a guess, and
         # the estimator already excludes cold-compile samples — both
-        # are real false-shed modes tier-1 caught
+        # are real false-shed modes tier-1 caught.  A prior-seeded
+        # estimate (this bucket's OWN static model) is admissible where
+        # a borrowed measurement is not: it is conservative (worst-case
+        # sweep counts) and bucket-specific, so "provably late" against
+        # it errs toward admitting.
         est = self.service_estimate(bucket, now=now, fallback=False)
-        if est is None or est["count"] < cfg.min_estimate_count \
-                or not est["mean_s"]:
+        if est is None or not est["mean_s"] or (
+                est["count"] < cfg.min_estimate_count
+                and not est.get("prior")):
             return None
         t = time.monotonic() if now is None else float(now)
         per_worker = self._workers() / est["mean_s"]
@@ -439,7 +505,7 @@ class TrafficShaper:
             "counters": {
                 name: counters.get(f"serve.shape.{name}", 0)
                 for name in ("holds", "bypass", "edf_promotions",
-                             "deadline_sheds")},
+                             "deadline_sheds", "prior_seeded")},
             "estimates": estimates,
             "retry_after_hint_s": round(self.retry_after_s(depth), 6),
         }

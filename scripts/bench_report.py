@@ -61,6 +61,10 @@ def default_footprint_paths() -> List[str]:
                                          "footprint_r*.json")))
 
 
+def default_cost_paths() -> List[str]:
+    return sorted(glob.glob(os.path.join(REPO, "runs", "cost_r*.json")))
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="scripts/bench_report.py",
@@ -139,6 +143,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # by default, the committed runs/footprint_r*.json history
     footprints = history.load_footprints(
         args.paths or default_footprint_paths())
+    # ...and the compute-cost model's (fcheck-cost runs/cost_r*.json):
+    # same convention — explicit paths restrict, default is the
+    # committed history
+    costs = history.load_costs(args.paths or default_cost_paths())
     if not args.quiet:
         print(history.trend_table(groups, markdown=args.markdown))
         devices = history.device_table(groups, markdown=args.markdown)
@@ -169,6 +177,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         if fp_table:
             print()
             print(fp_table)
+        c_table = history.cost_table(costs, markdown=args.markdown)
+        if c_table:
+            # fcheck-cost static roofline blocks: the dead-compute
+            # bill, the solo/batch duality price sheet, and the
+            # costliest modeled executables
+            print()
+            print(c_table)
     if not args.check:
         return 0
     problems = history.check_history(groups,
@@ -184,6 +199,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     # that trips the hang watchdog blocks, curve or no curve
     problems += history.check_flight(groups)
     problems += history.check_footprints(footprints)
+    # the fcheck-cost gates: modeled est_device_s growth between
+    # committed artifacts + the dead-compute waste budget...
+    problems += history.check_costs(costs)
+    # ...and the predicted-vs-measured calibration band that keeps the
+    # static model honest against the committed serve_load history
+    problems += history.check_cost_calibration(costs, groups)
     n_recs = sum(len(r) for r in groups.values())
     if problems:
         print(f"\nbench_report: {len(problems)} regression finding(s) "

@@ -12,7 +12,7 @@ REPORT="${FCHECK_REPORT:-runs/fcheck_report.json}"
 
 echo "== fcheck: AST lint + jaxpr audit =="
 JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis fastconsensus_tpu/ \
-    --json "$REPORT"
+    --json "$REPORT" --cost-out /tmp/fc_cost_regen.json
 rc=$?
 if [ $rc -ne 0 ]; then
     echo "fcheck failed (exit $rc); report at $REPORT" >&2
@@ -74,6 +74,96 @@ if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "\[surface-count\]"; then
 fi
 echo "footprint negative probes ok: tiny budgets fail naming their rule"
 
+echo "== fcheck-cost: compute-cost & roofline gate (report-driven) =="
+# same contract as the footprint stage: consume the --json report the
+# full gate already wrote (documented schema in analysis/cost.py)
+python - "$REPORT" <<'PYEOF'
+import json
+import sys
+
+blob = json.load(open(sys.argv[1]))
+cost = blob.get("cost")
+assert cost, "fcheck report carries no cost block"
+assert cost["tool"] == "fcheck-cost" and cost["version"] == 1, cost
+dead = cost["dead_compute"]
+# the ISSUE 16 headline: the measured lfr1k frontier series leaves the
+# late rounds majority-dead, and the committed bill passes its own
+# pinned budget
+assert dead["late_round_dead_frac"] >= 0.5, dead
+assert dead["run_dead_frac"] <= dead["waste_budget"], dead
+assert cost["duality"], "duality table is empty"
+assert cost["gate"] and cost["buckets"], "cost table is empty"
+cal = cost["calibration"]
+assert cal and cal["est_device_ms"] > 0, cal
+worst = max(cost["gate"], key=lambda r: r["est_device_s"])
+print(f"cost gate ok: dead-compute {dead['run_dead_frac']:.0%} of run "
+      f"FLOPs at {dead['bucket']} (late rounds "
+      f"{dead['late_round_dead_frac']:.0%}, budget "
+      f"{dead['waste_budget']:.0%}), costliest executable "
+      f"{worst['kind']} at {worst['bucket']} "
+      f"~{worst['est_device_s']:.1f}s, calibration "
+      f"{cal['est_device_ms']} ms device est")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "cost block in $REPORT failed its pins (exit $rc)" >&2
+    exit 1
+fi
+# the committed artifact is the regenerated one, byte for byte — a
+# posture or mirror change cannot land without refreshing it
+if ! diff -u runs/cost_r16.json /tmp/fc_cost_regen.json; then
+    echo "runs/cost_r16.json is stale — regenerate with" \
+         "python -m fastconsensus_tpu.analysis fastconsensus_tpu/" \
+         "--json /dev/null --cost-out runs/cost_r16.json" >&2
+    exit 1
+fi
+# jax-free negative probe: a tightened waste budget must FAIL naming
+# cost-dead-compute, through the mirror alone (no traces, no jax)
+out=$(JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
+    fastconsensus_tpu/ --no-jaxpr --only cost-dead-compute \
+    --waste-budget 0.1 2>&1)
+rc=$?
+if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "\[cost-dead-compute\]"; then
+    echo "tiny --waste-budget exited $rc without naming cost-dead-compute:" >&2
+    echo "$out" >&2
+    exit 1
+fi
+# predicted-vs-measured calibration gate: the committed model must land
+# within the band of the committed serve_load curve...
+python scripts/bench_report.py --check --quiet \
+    runs/bench_serve_load_r10.json runs/cost_r16.json
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "cost calibration gate failed on the committed artifacts" \
+         "(exit $rc)" >&2
+    exit 1
+fi
+# ...and a synthetically regressed copy one sequence later must FAIL
+# the trend gate naming cost-roofline-regress (a gate that can't fail
+# is no gate)
+COST_DIR=$(mktemp -d)
+python - runs/cost_r16.json "$COST_DIR/cost_r99.json" <<'PYEOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+for row in doc["gate"]:
+    row["est_device_s"] = round(row["est_device_s"] * 10, 9)
+json.dump(doc, open(sys.argv[2], "w"))
+PYEOF
+out=$(python scripts/bench_report.py --check --quiet \
+    runs/bench_serve_load_r10.json runs/cost_r16.json \
+    "$COST_DIR/cost_r99.json" 2>&1)
+rc=$?
+rm -rf "$COST_DIR"
+if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "cost-roofline-regress"; then
+    echo "roofline-regressed cost copy did not fail the gate" \
+         "(exit $rc):" >&2
+    echo "$out" >&2
+    exit 1
+fi
+echo "cost artifact in sync, calibration in band, regressed copy fails naming cost-roofline-regress"
+
 echo "== fcheck: violating fixtures must still be caught =="
 # guards against the analyzer silently going blind (a no-op analyzer
 # would pass the gate above forever); exit 1 means "found violations" —
@@ -101,6 +191,9 @@ for pair in \
     bad_surface_budget.py:surface-count \
     bad_padding_ladder.py:padding-waste \
     bad_footprint_budget.py:jaxpr-peak-bytes \
+    bad_cost_waste.py:cost-dead-compute \
+    bad_cost_duality.py:cost-duality \
+    bad_cost_regress.py:cost-roofline-regress \
     bad_phantom_reader.py:phantom-reader \
     bad_schema_drift.py:schema-drift \
     bad_dead_counter.py:dead-counter \
@@ -127,7 +220,7 @@ do
         exit 1
     fi
 done
-echo "fixtures: all 17 rules fire with their ids"
+echo "fixtures: all 20 rules fire with their ids"
 
 echo "== fcheck-contract: name-contract gate (jax-free) =="
 # ISSUE 14 acceptance: the whole-program contract pass over the live

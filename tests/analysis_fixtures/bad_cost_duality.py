@@ -1,0 +1,11 @@
+"""Violating fixture for the ``cost-duality`` rule: a posture that
+demands the batch rung save 90% per job over solo dispatch.  Under the
+roofline the batched executable amortizes only the dispatch overhead
+(device work scales linearly with the rung), so no ladder bucket comes
+near such a saving — the analyzer must price the duality honestly and
+fail the demand."""
+
+COST_SPEC = {
+    "duality_min_saving": 0.9,
+    "rules": ["cost-duality"],
+}

@@ -1,0 +1,11 @@
+"""Violating fixture for the ``cost-roofline-regress`` rule: a
+committed baseline claiming the floor bucket's solo rounds executable
+used to model at 1 ms device time.  The mirror prices it an order of
+magnitude above that, so against this baseline the surface has
+"regressed" far past the tolerance — the analyzer must name the drift
+instead of letting the baseline rot."""
+
+COST_SPEC = {
+    "baseline": {"rounds[warm]@n64_e96": 0.001},
+    "rules": ["cost-roofline-regress"],
+}

@@ -1,0 +1,11 @@
+"""Violating fixture for the ``cost-dead-compute`` rule: a posture
+whose pinned waste budget (40%) is tighter than the dead-compute bill
+the committed fcqual frontier series actually produces (~61% of the
+run's rounds-executable FLOPs on frozen vertices) — the analyzer must
+bill it at review time instead of letting the waste ride to the
+device."""
+
+COST_SPEC = {
+    "waste_budget": 0.4,
+    "rules": ["cost-dead-compute"],
+}

@@ -1,0 +1,9 @@
+"""Clean counterpart of bad_cost_duality.py: the repo default floor
+(0.0 — batching must merely never cost MORE per job than solo), which
+the dispatch-overhead amortization always clears — the rule must stay
+silent."""
+
+COST_SPEC = {
+    "duality_min_saving": 0.0,
+    "rules": ["cost-duality"],
+}

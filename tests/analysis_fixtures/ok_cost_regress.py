@@ -1,0 +1,8 @@
+"""Clean counterpart of bad_cost_regress.py: a baseline (1 s) the
+mirror's ~52 ms estimate for the same executable sits far below — no
+growth, the rule must stay silent."""
+
+COST_SPEC = {
+    "baseline": {"rounds[warm]@n64_e96": 1.0},
+    "rules": ["cost-roofline-regress"],
+}

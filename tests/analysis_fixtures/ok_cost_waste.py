@@ -1,0 +1,8 @@
+"""Clean counterpart of bad_cost_waste.py: the same posture under the
+repo's pinned waste budget (75%), which the measured ~61% dead-compute
+bill fits with headroom — the rule must stay silent."""
+
+COST_SPEC = {
+    "waste_budget": 0.75,
+    "rules": ["cost-dead-compute"],
+}

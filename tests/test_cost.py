@@ -1,0 +1,528 @@
+"""fcheck-cost suite: the eqn-level cost visitor on hand-computed
+jaxprs, the jax-free ladder mirror vs the traced visitor, the three
+cost rules + their fixture postures, the committed cost artifact, the
+history trend/calibration gates, and the runtime feedback paths (the
+shaper/429 prior seeding and the cost-weighted sticky spill)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COST_ARTIFACT = os.path.join(REPO, "runs", "cost_r16.json")
+SERVE_LOAD = os.path.join(REPO, "runs", "bench_serve_load_r10.json")
+QUALITY = os.path.join(REPO, "runs", "bench_lfr1k_quality_r12.json")
+
+
+# -- jax-free half: posture mirrors, the closed-form ladder mirror ----
+
+
+def test_cost_spec_mirrors_serve_defaults():
+    """Same contract as footprint.SurfaceSpec: the default posture the
+    cost pass prices must be the one ServeConfig actually serves, and
+    the sweep bound baked into the mirror coefficients must be the one
+    the kernels enforce."""
+    import inspect
+
+    from fastconsensus_tpu.analysis import cost
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.models import louvain
+    from fastconsensus_tpu.serve.server import ServeConfig
+
+    spec, cfg = cost.CostSpec(), ServeConfig()
+    assert spec.max_nodes == cfg.max_nodes
+    assert spec.max_edges == cfg.max_edges
+    assert spec.max_batch == cfg.max_batch
+    assert spec.n_p == ConsensusConfig().n_p
+    for fn in (louvain.local_move, louvain.modularity_levels):
+        sig = inspect.signature(fn)
+        assert sig.parameters["max_sweeps"].default == cost.MAX_SWEEPS
+
+
+def test_frontier_series_matches_committed_quality_artifact():
+    """The dead-compute bill prices the measured lfr1k frontier decay,
+    not an invented one: the default series is the committed fcqual
+    telemetry, verbatim."""
+    from fastconsensus_tpu.analysis import cost
+
+    with open(QUALITY, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    series = doc["telemetry"]["quality"]["frontier_frac_by_round"]
+    assert tuple(series) == cost.FRONTIER_SERIES_DEFAULT
+
+
+def test_mirror_cost_shapes_and_mode_suffix():
+    from fastconsensus_tpu.analysis import cost
+
+    solo = cost.mirror_cost("rounds", 64, 96, b=1, n_p=4)
+    assert solo["flops"] > 0 and solo["hbm_bytes"] > 0
+    # warm/cold/scratch share one traced program: the suffix never
+    # changes the modeled cost
+    assert cost.mirror_cost("rounds[warm]", 64, 96, n_p=4) == \
+        cost.mirror_cost("rounds[scratch]", 64, 96, n_p=4)
+    # linear in ensemble width and batch rung
+    p8 = cost.mirror_cost("rounds", 64, 96, b=1, n_p=8)
+    assert p8["flops"] == pytest.approx(2 * solo["flops"])
+    b2 = cost.mirror_cost("batch", 64, 96, b=2, n_p=4)
+    assert b2["flops"] == pytest.approx(2 * solo["flops"])
+    with pytest.raises(ValueError, match="unknown surface kind"):
+        cost.mirror_cost("nonsense", 64, 96)
+
+
+def test_static_prior_and_spill_weight():
+    from fastconsensus_tpu.analysis import cost
+
+    # the prior is exactly the mirrored solo rounds roofline
+    assert cost.static_service_prior("n64_e96", n_p=4) == \
+        pytest.approx(cost.mirror_est_s("rounds", 64, 96, b=1, n_p=4))
+    # non-ladder keys (group keys, mesh tags, junk) have no prior
+    for key in ("b", "unseen", "mesh:n64", "n64e96", "", None):
+        assert cost.static_service_prior(key) is None
+        assert cost.spill_weight(key) == 1.0
+    # interactive buckets keep weight 1.0 — identical routing to the
+    # unweighted era (the fcpool CI smoke pins this)
+    assert cost.spill_weight("n64_e96") == 1.0
+    assert cost.spill_weight("n128_e192") == 1.0
+    # minute-scale buckets clamp to the cap and spill early
+    assert cost.spill_weight("n1024_e1536") == cost.SPILL_WEIGHT_MAX
+    for key in ("n64_e96", "n512_e1024", "n4096_e8192"):
+        w = cost.spill_weight(key)
+        assert 1.0 <= w <= cost.SPILL_WEIGHT_MAX
+
+
+def test_dead_compute_bill_hand_math():
+    """The bill is pure arithmetic over the committed frontier series:
+    dead fraction per round = 1 - frontier_frac, run fraction = the
+    mean, late = the mean of the second half."""
+    from fastconsensus_tpu.analysis import cost
+
+    spec = cost.CostSpec()
+    bill = cost.dead_compute_bill(spec)
+    series = spec.frontier_series
+    assert bill["bucket"] == "n1024_e6144" and bill["n_p"] == 20
+    assert bill["rounds"] == len(series)
+    expect_run = sum(1.0 - f for f in series) / len(series)
+    assert bill["run_dead_frac"] == pytest.approx(expect_run, abs=1e-6)
+    late = [1.0 - f for f in series[len(series) // 2:]]
+    assert bill["late_round_dead_frac"] == \
+        pytest.approx(sum(late) / len(late), abs=1e-6)
+    rf = cost.mirror_cost("rounds", 1024, 6144, b=1, n_p=20)["flops"]
+    assert bill["round_flops"] == int(rf)
+    for row, frac in zip(bill["per_round"], series):
+        assert row["dead_flops"] == int(rf * (1.0 - frac))
+
+
+def test_cost_rules_fire_and_stay_silent():
+    from fastconsensus_tpu.analysis import cost
+
+    # dead-compute: default budget holds, a tightened one fires
+    assert not cost.check_dead_compute(cost.CostSpec())[0]
+    diags, bill = cost.check_dead_compute(
+        cost.CostSpec(waste_budget=0.25))
+    assert len(diags) == 1 and diags[0].rule == "cost-dead-compute"
+    assert f"{bill['run_dead_frac']:.2f}" in diags[0].message
+    # duality: batching always amortizes dispatch, so the 0.0 floor
+    # holds; an absurd floor fires once (one finding prices the posture)
+    diags, rows = cost.check_duality(cost.CostSpec())
+    assert not diags and rows
+    assert all(r["per_job_saving_frac"] >= 0.0 for r in rows)
+    diags, _ = cost.check_duality(cost.CostSpec(duality_min_saving=0.9))
+    assert len(diags) == 1 and diags[0].rule == "cost-duality"
+    # roofline regress: a stale baseline fires, a generous one holds
+    fired = cost.check_regress(cost.CostSpec(
+        baseline={"rounds[warm]@n64_e96": 0.001}))
+    assert len(fired) == 1 and fired[0].rule == "cost-roofline-regress"
+    assert not cost.check_regress(cost.CostSpec(
+        baseline={"rounds[warm]@n64_e96": 1.0}))
+    with pytest.raises(ValueError, match="kind@n"):
+        cost.check_regress(cost.CostSpec(baseline={"junk": 1.0}))
+
+
+def test_fixture_specs_fire_their_rule_only():
+    """The bad_/ok_ COST_SPEC fixtures drive each rule in isolation
+    through the same evaluate() path the CLI uses."""
+    from fastconsensus_tpu.analysis import cost
+
+    def run(name):
+        specs = cost.find_specs([os.path.join(FIXTURES, name)])
+        assert len(specs) == 1, name
+        diags, _ = cost.evaluate(specs[0])
+        return {d.rule for d in diags}
+
+    assert run("bad_cost_waste.py") == {"cost-dead-compute"}
+    assert run("ok_cost_waste.py") == set()
+    assert run("bad_cost_duality.py") == {"cost-duality"}
+    assert run("ok_cost_duality.py") == set()
+    assert run("bad_cost_regress.py") == {"cost-roofline-regress"}
+    assert run("ok_cost_regress.py") == set()
+
+
+def test_find_specs_rejects_junk(tmp_path):
+    from fastconsensus_tpu.analysis import cost
+
+    (tmp_path / "bad.py").write_text("COST_SPEC = {'no_such': 1}\n")
+    with pytest.raises(ValueError, match="no_such"):
+        cost.find_specs([str(tmp_path)])
+    (tmp_path / "bad.py").write_text(
+        "COST_SPEC = {'rules': ['surface-count']}\n")
+    with pytest.raises(ValueError, match="not cost rules"):
+        cost.find_specs([str(tmp_path)])
+    (tmp_path / "bad.py").write_text("COST_SPEC = {'baseline': 3}\n")
+    with pytest.raises(ValueError, match="baseline"):
+        cost.find_specs([str(tmp_path)])
+
+
+def test_cost_rules_jax_free_subprocess():
+    """ISSUE 16 acceptance: the three cost rules over the live repo in
+    a process where any jax import raises — exit 0 clean, and a
+    tightened waste budget fires the dead-compute bill (exit 1)."""
+    def run(extra):
+        code = (
+            "import sys; sys.modules['jax'] = None; "
+            "from fastconsensus_tpu.analysis.__main__ import main; "
+            "sys.exit(main(['fastconsensus_tpu/', '--no-jaxpr', "
+            "'--only', 'cost-dead-compute,cost-duality,"
+            "cost-roofline-regress'] + %r))" % (extra,))
+        return subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                              capture_output=True, text=True,
+                              timeout=300)
+
+    proc = run(["--quiet"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = run(["--waste-budget", "0.1"])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "[cost-dead-compute]" in proc.stdout
+
+
+# -- the eqn-level visitor on hand-computed jaxprs --------------------
+
+
+def test_eqn_cost_dot_general_hand_computed():
+    """(8,16) @ (16,4): 2*M*N*K = 1024 flops; bytes = operands +
+    result = (128 + 64 + 32) * 4."""
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.analysis.cost import eqn_cost
+
+    closed = jax.make_jaxpr(lambda a, b: a @ b)(
+        jax.ShapeDtypeStruct((8, 16), jnp.float32),
+        jax.ShapeDtypeStruct((16, 4), jnp.float32))
+    c = eqn_cost(closed)
+    assert c["flops"] == 2 * 8 * 4 * 16
+    assert c["hbm_bytes"] == (8 * 16 + 16 * 4 + 8 * 4) * 4
+
+
+def test_eqn_cost_scatter_add_counts_updates():
+    """Scatter-add prices one combine op per UPDATE element, never per
+    operand slot: 4 updates into a 32-slot operand is 4 scatter flops
+    plus the jnp negative-index wrap (lt + add over the 4 indices,
+    select_n is movement) = 12 total — not 32+."""
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.analysis.cost import eqn_cost
+
+    closed = jax.make_jaxpr(lambda x, i, u: x.at[i].add(u))(
+        jax.ShapeDtypeStruct((32,), jnp.float32),
+        jax.ShapeDtypeStruct((4,), jnp.int32),
+        jax.ShapeDtypeStruct((4,), jnp.float32))
+    assert eqn_cost(closed)["flops"] == 4 + 4 + 4
+
+
+def test_eqn_cost_while_prices_the_sweep_budget():
+    """A data-dependent while is priced at the budget the kernel
+    enforces: bound x (cond + body) — here 1 flop each, so exactly
+    2 * bound, linear in the bound."""
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.analysis.cost import eqn_cost
+
+    closed = jax.make_jaxpr(lambda x: jax.lax.while_loop(
+        lambda c: c < 10.0, lambda c: c + 1.0, x))(
+        jax.ShapeDtypeStruct((), jnp.float32))
+    assert eqn_cost(closed, while_bound=7)["flops"] == 14.0
+    assert eqn_cost(closed, while_bound=14)["hbm_bytes"] == \
+        2 * eqn_cost(closed, while_bound=7)["hbm_bytes"]
+
+
+def test_eqn_cost_scan_prices_length_times_body():
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.analysis.cost import eqn_cost
+
+    def f(x, xs):
+        return jax.lax.scan(lambda c, v: (c + v, c * v), x, xs)
+
+    closed = jax.make_jaxpr(f)(
+        jax.ShapeDtypeStruct((), jnp.float32),
+        jax.ShapeDtypeStruct((9,), jnp.float32))
+    assert eqn_cost(closed)["flops"] == 9 * 2
+
+
+def test_eqn_cost_movement_is_bytes_only():
+    import jax
+    import jax.numpy as jnp
+
+    from fastconsensus_tpu.analysis.cost import eqn_cost
+
+    closed = jax.make_jaxpr(lambda x: x.reshape(4, 8).T)(
+        jax.ShapeDtypeStruct((32,), jnp.float32))
+    c = eqn_cost(closed)
+    assert c["flops"] == 0.0 and c["hbm_bytes"] > 0
+
+
+# -- traced half: mirror band, the report block -----------------------
+
+
+def test_mirror_tracks_traced_visitor_within_band():
+    """The closed-form coefficients are least-squares fits of the
+    traced visitor; at the ladder floor they must sit within a tight
+    ratio band of the real trace (the pre-commit hook prices postures
+    with the mirror alone)."""
+    from fastconsensus_tpu.analysis import cost
+
+    spec = cost.CostSpec(max_nodes=256, max_edges=512, max_batch=2,
+                         n_p=4)
+    traced = cost._trace_cost("rounds", 64, 96, 1, "warm", spec)
+    mirror = cost.mirror_cost("rounds", 64, 96, b=1, n_p=4)
+    assert mirror["flops"] == pytest.approx(traced["flops"], rel=0.25)
+    assert mirror["hbm_bytes"] == \
+        pytest.approx(traced["hbm_bytes"], rel=0.25)
+
+
+def test_evaluate_block_schema():
+    """The cost block the --json report and the runs/cost_rNN.json
+    artifact carry (the documented schema scripts/bench_report.py
+    consumes)."""
+    from fastconsensus_tpu.analysis import cost
+
+    spec = cost.CostSpec(max_nodes=256, max_edges=512, max_batch=2,
+                         n_p=4)
+    diags, block = cost.evaluate(spec, with_table=True)
+    assert not diags
+    assert block["tool"] == "fcheck-cost" and block["version"] == 1
+    assert block["dead_compute"]["run_dead_frac"] > 0
+    assert block["duality"] and block["gate"] and block["buckets"]
+    for row in block["gate"]:
+        assert set(row) >= {"kind", "bucket", "batch", "flops",
+                            "hbm_bytes", "arith_intensity",
+                            "est_device_s"}
+        assert row["est_device_s"] > 0
+    cal = block["calibration"]
+    assert cal["bucket"] == "n64_e96" and cal["est_device_ms"] > 0
+    # jax-free selection never touches the traced half
+    d2, b2 = cost.evaluate(cost.CostSpec(), rules=list(cost.COST_RULES))
+    assert not d2
+    assert b2["gate"] == [] and b2["buckets"] == []
+    assert b2["calibration"] is None
+
+
+# -- the committed artifact + history gates ---------------------------
+
+
+def test_committed_cost_artifact_is_consistent():
+    """runs/cost_r16.json is the mirror's own output: the dead-compute
+    bill re-derives exactly, the lfr1k late rounds are majority-dead
+    (the ISSUE 16 headline), and the artifact passes its own budget."""
+    from fastconsensus_tpu.analysis import cost
+
+    with open(COST_ARTIFACT, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc["tool"] == "fcheck-cost" and doc["version"] == 1
+    bill = cost.dead_compute_bill(cost.CostSpec())
+    assert doc["dead_compute"] == bill
+    assert doc["dead_compute"]["late_round_dead_frac"] >= 0.5
+    assert doc["dead_compute"]["run_dead_frac"] <= \
+        doc["dead_compute"]["waste_budget"]
+    assert doc["duality"] == cost.duality_table(cost.CostSpec())
+    assert doc["gate"] and doc["calibration"]
+
+
+def test_history_cost_trend_and_regress_gate(tmp_path):
+    from fastconsensus_tpu.obs import history
+
+    with open(COST_ARTIFACT, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    a = tmp_path / "cost_r16.json"
+    a.write_text(json.dumps(doc))
+    junk = tmp_path / "cost_rX.json"
+    junk.write_text('{"tool": "something-else"}')
+    # a stable successor passes
+    b = tmp_path / "cost_r17.json"
+    b.write_text(json.dumps(doc))
+    costs = history.load_costs([str(b), str(junk), str(a)])
+    assert [c["seq"] for c in costs] == [16, 17]
+    table = history.cost_table(costs, markdown=False)
+    assert "fcheck-cost trend" in table and "cost duality" in table
+    assert history.check_costs(costs) == []
+    # a 10x roofline blowup in the newest artifact fires per row
+    worse = json.loads(json.dumps(doc))
+    for g in worse["gate"]:
+        g["est_device_s"] = g["est_device_s"] * 10.0
+    b.write_text(json.dumps(worse))
+    probs = history.check_costs(history.load_costs([str(a), str(b)]))
+    assert probs and all("cost-roofline-regress" in p for p in probs)
+    # a dead-compute bill over its own pinned budget fires too
+    breach = json.loads(json.dumps(doc))
+    breach["dead_compute"]["waste_budget"] = 0.1
+    b.write_text(json.dumps(breach))
+    probs = history.check_costs(history.load_costs([str(a), str(b)]))
+    assert any("cost-dead-compute" in p for p in probs)
+
+
+def test_calibration_gate_vs_committed_serve_load(tmp_path):
+    """The model's honesty gate: the committed artifact's predicted
+    device time for the serve_load reference executable lands within
+    the band of the measured committed curve — and a drifted model is
+    named."""
+    from fastconsensus_tpu.obs import history
+
+    costs = history.load_costs([COST_ARTIFACT])
+    groups = history.build_history([SERVE_LOAD])
+    assert history.check_cost_calibration(costs, groups) == []
+    # a model off by 100x is outside any honest band
+    with open(COST_ARTIFACT, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    doc["calibration"]["est_device_ms"] *= 100.0
+    drifted = tmp_path / "cost_r17.json"
+    drifted.write_text(json.dumps(doc))
+    probs = history.check_cost_calibration(
+        history.load_costs([str(drifted)]), groups)
+    assert len(probs) == 1 and "calibration drift" in probs[0]
+
+
+# -- runtime feedback: prior-seeded shaping, cost-weighted spill ------
+
+
+def _fresh_lat():
+    from fastconsensus_tpu.obs.latency import LatencyRegistry
+
+    return LatencyRegistry()
+
+
+def _shaper(lat=None, **kw):
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.shaping import (ShapingConfig,
+                                                 TrafficShaper)
+
+    cfg_over = {k: v for k, v in kw.items() if k != "cost_prior"}
+    return TrafficShaper(ShapingConfig(**cfg_over),
+                         lat=lat if lat is not None else _fresh_lat(),
+                         reg=obs_counters.get_registry(),
+                         cost_prior=kw.get("cost_prior"))
+
+
+def test_latency_service_estimate_accepts_prior():
+    from fastconsensus_tpu.obs.latency import LatencyRegistry
+
+    lat = LatencyRegistry()
+    assert lat.service_estimate("b") is None
+    est = lat.service_estimate("b", prior=0.05)
+    assert est == {"count": 0, "mean_s": 0.05, "p95_s": 0.05,
+                   "prior": True}
+    # any measured history beats the model
+    for phase in ("pack", "device", "fanout"):
+        lat.hist(f"serve.phase.{phase}", bucket="b", rung=1).record(0.01)
+    est = lat.service_estimate("b", prior=9.9)
+    assert est["count"] == 1 and not est.get("prior")
+
+
+def test_shaper_cold_bucket_consumes_static_prior():
+    """ISSUE 16 acceptance: a cold ladder bucket's Retry-After and shed
+    decision derive from the static cost prior instead of the 1.0 s
+    constant, and serve.shape.prior_seeded counts the bucket once."""
+    from fastconsensus_tpu.analysis import cost
+    from fastconsensus_tpu.obs import counters as obs_counters
+
+    reg = obs_counters.get_registry()
+    base = reg.counters()
+    sh = _shaper(lat=_fresh_lat())          # real default prior
+    prior = cost.static_service_prior("n64_e96")
+    # retry: depth x prior / workers, not retry_after_default_s
+    assert sh.retry_after_s(10, "n64_e96") == \
+        pytest.approx(10 * prior, rel=1e-6)
+    # shed: 50 queued jobs at ~52 ms each provably miss a 1 ms deadline
+    import time as _time
+    now = _time.monotonic()
+    reason = sh.should_shed("n64_e96", now + 0.001, depth=50, now=now)
+    assert reason is not None and "deadline shed" in reason
+    # ...while a generous deadline still admits
+    assert sh.should_shed("n64_e96", now + 60.0, depth=50,
+                          now=now) is None
+    # the counter counts buckets, not lookups
+    sh.retry_after_s(10, "n64_e96")
+    since = reg.counters_since(base)
+    assert since.get("serve.shape.prior_seeded", 0) == 1
+    assert "prior_seeded" in sh.describe()["counters"]
+
+
+def test_shaper_disabled_prior_restores_cold_defaults():
+    """lambda b: None disables seeding outright: the pre-prior cold
+    behavior (constant Retry-After, never shed) is one injection away."""
+    sh = _shaper(lat=_fresh_lat(), cost_prior=lambda b: None)
+    assert sh.retry_after_s(10, "n64_e96") == 1.0
+    import time as _time
+    now = _time.monotonic()
+    assert sh.should_shed("n64_e96", now + 0.001, depth=50,
+                          now=now) is None
+    # an injected model is consumed verbatim
+    sh2 = _shaper(lat=_fresh_lat(), cost_prior=lambda b: 0.2)
+    assert sh2.retry_after_s(10, "anything") == pytest.approx(2.0)
+    # a throwing prior means "no prior", never a broken admission path
+    def boom(bucket):
+        raise RuntimeError("broken analyzer")
+    sh3 = _shaper(lat=_fresh_lat(), cost_prior=boom)
+    assert sh3.retry_after_s(10, "n64_e96") == 1.0
+
+
+def test_scheduler_weights_backlog_by_cost():
+    """A queued minute-scale job must weigh its drain time: with weight
+    8 a single queued job spills off the home; unit weight preserves
+    the sticky era exactly."""
+    from fastconsensus_tpu.serve.scheduler import StickyScheduler
+
+    class W:
+        def __init__(self, idx, load=0):
+            self.idx, self._load = idx, load
+
+        def eligible(self, exclude=frozenset()):
+            return self.idx not in exclude
+
+        def load(self):
+            return self._load
+
+        def is_warm(self, bucket):
+            return False
+
+    heavy = StickyScheduler(spill_backlog=2, cost_weight=lambda b: 8.0)
+    ws = [W(0), W(1)]
+    assert heavy.route("n1024_e1536", ws).idx == 0      # mints home
+    ws[0]._load = 1
+    # 1 queued job x weight 8 > backlog 2: spill where unweighted stuck
+    assert heavy.route("n1024_e1536", ws).idx == 1
+    unit = StickyScheduler(spill_backlog=2, cost_weight=lambda b: 1.0)
+    assert unit.route("n64_e96", ws).idx == 1           # least loaded
+    ws[1]._load = 2
+    assert unit.route("n64_e96", ws).idx == 1           # sticky at 2x1
+    # a throwing weight degrades to the unweighted era
+    bad = StickyScheduler(spill_backlog=2,
+                          cost_weight=lambda b: 1 / 0)
+    ws[0]._load, ws[1]._load = 0, 0
+    assert bad.route("n64_e96", ws).idx == 0
+    ws[0]._load = 2
+    assert bad.route("n64_e96", ws).idx == 0            # sticky at 2
+
+def test_pool_wires_real_spill_weight():
+    from fastconsensus_tpu.serve import pool as pool_mod
+
+    fn = pool_mod._cost_spill_weight()
+    assert fn is not None
+    assert fn("n64_e96") == 1.0
+    assert fn("n1024_e1536") > 1.0

@@ -593,7 +593,7 @@ def test_shaping_block_schema_and_typed_parse():
                           "retry_after_hint_s"}
     assert set(block["counters"]) == {"holds", "bypass",
                                       "edf_promotions",
-                                      "deadline_sheds"}
+                                      "deadline_sheds", "prior_seeded"}
     assert bucket_key in block["estimates"]
     typed = ShapingStats.from_payload(block)
     assert typed.edf and typed.hold and typed.shed
