@@ -977,6 +977,469 @@ def bench_serve_load() -> int:
     return 0 if ok else 1
 
 
+def bench_serve_fleet() -> int:
+    """The ``serve_fleet`` scenario: horizontal scale-out (fcfleet).
+
+    N real ``python -m fastconsensus_tpu.serve`` replica PROCESSES
+    behind the consistent-hash router (serve/router.py), grown 1 -> 2
+    -> 4 via :meth:`FleetManager.add_replica` (so every join exercises
+    prewarm shipping), each fleet size driven with open-loop Poisson
+    arrivals over a mixed-bucket workload — one route key per shape
+    bucket, so the ring actually has placements to disagree about.
+
+    **Weak scaling by design**: the offered load is ``N x R0`` rps
+    (R0 per replica), because every replica here shares ONE host CPU
+    core — the per-replica work is constant and the fleet gate is
+    "achieved throughput tracks offered as the fleet grows", which on
+    real multi-host hardware is the near-linear strong-scaling claim.
+    The CPU caveat is stamped into the artifact (``shared_host``).
+
+    After the scaling sweep, the chaos drill (the PR 15 fault harness
+    one level up): every base replica is armed with a drain-time
+    disk-full (``ResultCache.spill`` raises OSError — periodic spills
+    are unaffected), a COLD joiner is armed with a device-path fault
+    that fails every job it runs, and mid-burst the victim replica is
+    SIGTERMed.  The router must cordon + re-home, replay the faulted
+    and in-flight jobs, and the burst must finish with ZERO
+    client-visible failures; flight bundles are collected from every
+    surviving replica (SIGQUIT), and a re-submission of a job the dead
+    victim served must answer CACHED from the successor that inherited
+    its periodically-spilled cache file.
+
+    Env knobs: FCTPU_SERVE_FLEET_SIZES (default "1,2,4"),
+    FCTPU_SERVE_FLEET_RPS0 (per-replica offered rps, default 2),
+    FCTPU_SERVE_FLEET_SECONDS (per point, default 8),
+    FCTPU_SERVE_FLEET_DRILL_SECONDS (default 10),
+    FCTPU_SERVE_FLEET_SLO (default interactive),
+    FCTPU_SERVE_FLEET_WORKDIR (default: a fresh temp dir),
+    FCTPU_SERVE_FLEET_OUT (also write the JSON artifact —
+    runs/bench_serve_fleet_rNN.json is the committed, gated shape).
+    """
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve import bucketer
+    from fastconsensus_tpu.serve.client import (Backpressure, JobFailed,
+                                                ServeClient)
+    from fastconsensus_tpu.serve.fleet import FleetManager
+    from fastconsensus_tpu.serve.router import HashRing
+    from fastconsensus_tpu.serve.router import route_key as fleet_route_key
+
+    sizes = [int(x) for x in os.environ.get(
+        "FCTPU_SERVE_FLEET_SIZES", "1,2,4").split(",")]
+    if sizes[0] != 1 or sizes != sorted(sizes):
+        raise ValueError("FCTPU_SERVE_FLEET_SIZES must be ascending and "
+                         "start at 1 (the scaling reference)")
+    rps0 = float(os.environ.get("FCTPU_SERVE_FLEET_RPS0", "2"))
+    point_seconds = float(os.environ.get("FCTPU_SERVE_FLEET_SECONDS", "8"))
+    drill_seconds = float(os.environ.get(
+        "FCTPU_SERVE_FLEET_DRILL_SECONDS", "10"))
+    slo_class = os.environ.get("FCTPU_SERVE_FLEET_SLO", "interactive")
+    out_path = os.environ.get("FCTPU_SERVE_FLEET_OUT")
+    workdir = os.environ.get("FCTPU_SERVE_FLEET_WORKDIR")
+    tmpdir = None
+    if not workdir:
+        tmpdir = tempfile.mkdtemp(prefix="fcfleet_bench_")
+        workdir = tmpdir
+
+    n_p, max_rounds = 2, 2
+    # One route key per bucket (same config every submit): four shape
+    # buckets on the {2^k, 3*2^k} grid give the ring four placements
+    # to spread/re-home — seeds vary per job, which keeps content
+    # hashes distinct (no cache hits inside the timed sweep) while
+    # sharing one executable per bucket (batch_group excludes seed).
+    buckets = [bucketer.bucket_for(64, e) for e in (64, 96, 128, 192)]
+    bucket_edges = [bucketer.probe_edges(b).tolist() for b in buckets]
+    warm_specs = tuple(f"{b.key()}:1" for b in buckets)
+
+    DRAIN_FAULT = "fastconsensus_tpu.serve.cache:ResultCache.spill:OSError"
+    DEVICE_FAULT = ("fastconsensus_tpu.serve.bucketer:pad_to_bucket:"
+                    "ValueError")
+
+    reg = obs_counters.get_registry()
+    pct = obs_counters.percentile
+    seed_counter = iter(range(10_000_000))
+
+    fleet = FleetManager(
+        workdir, warm=warm_specs,
+        replica_args=("--max-batch", "1", "--queue-depth", "64",
+                      "--warm-config",
+                      json.dumps({"n_p": n_p, "max_rounds": max_rounds}),
+                      "--quiet"),
+        cache_spill_s=1.0, poll_s=0.25)
+
+    def replica_counters(rep) -> dict:
+        try:
+            m = ServeClient(rep.base_url, timeout=10.0).metricsz()
+            return dict(m.get("fcobs", {}).get("counters", {}))
+        except Exception:  # noqa: BLE001 — a dead/draining replica
+            # simply contributes nothing to the sum; the burst-level
+            # failed/stranded accounting is the gate, not this snapshot
+            return {}
+
+    def counters_sum(snaps_before: dict, key: str) -> int:
+        total = 0
+        for name, rep in fleet.replicas.items():
+            after = replica_counters(rep)
+            if not after:
+                continue
+            total += int(after.get(key, 0)
+                         - snaps_before.get(name, {}).get(key, 0))
+        return total
+
+    def run_burst(client: ServeClient, rps: float, seconds: float,
+                  rng_seed: int) -> dict:
+        """Open-loop Poisson submissions through the ROUTER, cycling
+        the bucket mix; completion polling via the router's proxied
+        /result (which is what drives its failover/replay machinery).
+        """
+        rng = np.random.default_rng(rng_seed)
+        offsets, t = [], 0.0
+        while True:
+            t += float(rng.exponential(1.0 / rps))
+            if t > seconds:
+                break
+            offsets.append(t)
+        outstanding: dict = {}
+        done_lock = threading.Lock()
+        submit_done = threading.Event()
+        latencies_ms: list = []
+        records: list = []
+        spread: dict = {}
+        failed = [0]
+        last_done = [0.0]
+
+        def poll_loop():
+            # fcheck: ok=sync-in-loop (HTTP polling of the loopback
+            # router for job completion — the load generator's whole
+            # job; latencies come from the replica's server-side
+            # monotonic timing block, not this poll clock)
+            while True:
+                with done_lock:
+                    pending = list(outstanding.items())
+                if not pending:
+                    if submit_done.is_set():
+                        return
+                    time.sleep(0.002)
+                    continue
+                for jid, meta in pending:
+                    try:
+                        res = client.result(jid)
+                    except JobFailed:
+                        with done_lock:
+                            outstanding.pop(jid, None)
+                        failed[0] += 1
+                        continue
+                    except Exception:  # noqa: BLE001 — a transient
+                        # socket error must not kill the poller; the
+                        # job stays outstanding and is retried next
+                        # sweep (a dead router surfaces as stranded
+                        # jobs, which fail the scenario)
+                        continue
+                    if "partitions" not in res:
+                        continue   # still pending (202 payload)
+                    with done_lock:
+                        outstanding.pop(jid, None)
+                    timing = res.get("timing") or {}
+                    if timing.get("e2e_ms") is not None:
+                        latencies_ms.append(float(timing["e2e_ms"]))
+                    rep_name = res.get("fleet_replica") or "?"
+                    spread[rep_name] = spread.get(rep_name, 0) + 1
+                    records.append({"bucket": meta[1], "seed": meta[2],
+                                    "replica": rep_name,
+                                    "replays": res.get("fleet_replays",
+                                                       0)})
+                    last_done[0] = time.monotonic()
+                time.sleep(0.002)
+
+        poller = threading.Thread(target=poll_loop, daemon=True)
+        poller.start()
+        submitted = rejected = 0
+        t0 = time.monotonic()
+        # fcheck: ok=sync-in-loop (the open-loop arrival clock: sleep
+        # until each Poisson arrival, then one loopback submit)
+        for off in offsets:
+            delay = (t0 + off) - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            bi = submitted % len(buckets)
+            seed = next(seed_counter)
+            submitted += 1
+            try:
+                sub = client.submit(
+                    edges=bucket_edges[bi], n_nodes=buckets[bi].n_class,
+                    algorithm="louvain", n_p=n_p, max_rounds=max_rounds,
+                    seed=seed, slo=slo_class, priority=slo_class)
+            except Backpressure:
+                rejected += 1
+                continue
+            with done_lock:
+                outstanding[sub["job_id"]] = (t0 + off, bi, seed)
+        submit_done.set()
+        poller.join(120.0 + seconds)
+        with done_lock:
+            stranded = len(outstanding)
+        latencies_ms.sort()
+        span = max(last_done[0] - t0, 1e-9)
+        return {
+            "submitted": submitted,
+            "completed": len(records),
+            "failed": failed[0],
+            "stranded": stranded,
+            "rejected_429": rejected,
+            "achieved_rps": round(len(records) / span, 4),
+            "p50_ms": round(pct(latencies_ms, 0.50), 3)
+            if latencies_ms else None,
+            "p95_ms": round(pct(latencies_ms, 0.95), 3)
+            if latencies_ms else None,
+            "p99_ms": round(pct(latencies_ms, 0.99), 3)
+            if latencies_ms else None,
+            "route_spread": dict(sorted(spread.items())),
+            "records": records,
+        }
+
+    points: list = []
+    drill: dict = {}
+    total_warm = 0
+    drain_codes: dict = {}
+    try:
+        # every base replica carries the drain-time disk-full fault
+        # (count=1): inert while serving — the periodic spill goes
+        # through spill_if_dirty/_spill_locked, never the armed
+        # ResultCache.spill wrapper — so the ONE replica SIGTERMed
+        # mid-drill (and later, teardown drains) must absorb it
+        print("serve_fleet: spawning replica r0 (prewarm "
+              f"{len(warm_specs)} buckets)...", file=sys.stderr)
+        fleet.spawn("r0", fault=DRAIN_FAULT, fault_count=1)
+        url = fleet.start_router()
+        client = ServeClient(url, timeout=30.0)
+        for size in sizes:
+            while len(fleet.replicas) < size:
+                name = f"r{len(fleet.replicas)}"
+                print(f"serve_fleet: joining replica {name} (prewarm "
+                      f"shipping)...", file=sys.stderr)
+                fleet.add_replica(name, env_extra={
+                    "FCTPU_FAULT_INJECT": DRAIN_FAULT,
+                    "FCTPU_FAULT_INJECT_COUNT": "1"})
+            before = {n: replica_counters(r)
+                      for n, r in fleet.replicas.items()}
+            offered = size * rps0
+            print(f"serve_fleet: point replicas={size} "
+                  f"offered={offered:g} rps...", file=sys.stderr)
+            burst = run_burst(client, offered, point_seconds,
+                              rng_seed=size * 1000 + 7)
+            # settle: a replica marks DONE a moment before it folds the
+            # SLO verdict — sample too early and attainment reads short
+            settle_deadline = time.monotonic() + 5.0
+            # fcheck: ok=sync-in-loop (host-side counter polling)
+            while time.monotonic() < settle_deadline:
+                if (counters_sum(before, "serve.slo.met")
+                        + counters_sum(before, "serve.slo.missed")
+                        >= burst["completed"]):
+                    break
+                time.sleep(0.05)
+            met = counters_sum(before, "serve.slo.met")
+            missed = counters_sum(before, "serve.slo.missed")
+            warm = counters_sum(before, "serve.xla_compiles")
+            total_warm += warm
+            burst.pop("records")
+            point = dict(burst, replicas=size, offered_rps=offered,
+                         seconds=point_seconds,
+                         attainment=round(met / (met + missed), 4)
+                         if met + missed else None,
+                         slo={"met": met, "missed": missed},
+                         compiles=warm)
+            if warm:
+                print(f"WARNING: fleet point replicas={size} compiled "
+                      f"{warm} executable(s) — prewarm/shipping is not "
+                      f"holding", file=sys.stderr)
+            points.append(point)
+
+        # ---- chaos drill on the full fleet --------------------------
+        stats = fleet.router.fleet_stats()
+        keys = list(stats["assignments"])
+        # a COLD joiner armed with the device-path fault: it never
+        # pre-warms (pad_to_bucket is armed forever), so every job the
+        # ring hands it fails server-side and the router must replay.
+        # Placement is a pure function of member names, so probe trial
+        # rings for a name that takes SOME keys but not all of them
+        # (the drill needs both a faulty owner and a healthy victim).
+        def _taken(cand: str) -> int:
+            return sum(1 for k in keys
+                       if fleet.router.ring.preview_owner(k, cand))
+
+        rf_name = next(f"rf{i}" for i in range(256)
+                       if 0 < _taken(f"rf{i}") < len(keys))
+        # victim: the base replica owning the fewest (but >= 1) route
+        # keys AFTER the joiner lands, so the kill provably re-homes
+        # live traffic without depending on ring luck
+        trial = HashRing((*fleet.router.ring.members(), rf_name),
+                         vnodes=fleet.router.ring.vnodes)
+        owners: dict = {}
+        for k in keys:
+            owners.setdefault(trial.route(k), []).append(k)
+        victim = min((n for n in owners if n != rf_name),
+                     key=lambda n: (len(owners[n]), n))
+        print(f"serve_fleet: drill — victim={victim} "
+              f"(drain-time disk-full), joiner={rf_name} "
+              f"(device-path fault)...", file=sys.stderr)
+        fleet.spawn(rf_name, fault=DEVICE_FAULT, fault_count=-1,
+                    warm=())
+        fleet_before = {k: v for k, v in reg.counters().items()
+                        if k.startswith("serve.fleet.")}
+        rep_before = {n: replica_counters(r)
+                      for n, r in fleet.replicas.items()}
+        kill_result: dict = {}
+
+        def kill_mid_burst():
+            time.sleep(drill_seconds * 0.4)
+            print(f"serve_fleet: SIGTERM {victim} mid-burst (rolling "
+                  f"drain, disk-full armed)...", file=sys.stderr)
+            kill_result["exit"] = fleet.kill(victim, graceful=True)
+            kill_result["successor"] = fleet.on_death(victim)
+
+        killer = threading.Thread(target=kill_mid_burst, daemon=True)
+        killer.start()
+        burst = run_burst(client, sizes[-1] * rps0, drill_seconds,
+                          rng_seed=4242)
+        killer.join(180.0)
+        drill_warm = counters_sum(rep_before, "serve.xla_compiles")
+        total_warm += drill_warm
+        bundles = fleet.snapshot_bundles()
+        fleet_after = {k: v for k, v in reg.counters().items()
+                       if k.startswith("serve.fleet.")}
+        fleet_diff = {k: int(v - fleet_before.get(k, 0))
+                      for k, v in sorted(fleet_after.items())
+                      if v != fleet_before.get(k, 0)}
+
+        # cross-replica cache inheritance: re-submit a job the DEAD
+        # victim served during the burst — its periodic spill file was
+        # loaded into the successor (on_death), so the answer must come
+        # back cached without any device work
+        resubmit = {"found_victim_job": False}
+        cordoned = frozenset(
+            r["name"] for r in fleet.router.fleet_stats()["replicas"]
+            if r["state"] == "cordoned")
+        candidates = []
+        for rec in burst["records"]:
+            if rec["replica"] != victim:
+                continue
+            bi = rec["bucket"]
+            payload = {"edges": bucket_edges[bi],
+                       "n_nodes": buckets[bi].n_class,
+                       "algorithm": "louvain", "n_p": n_p,
+                       "max_rounds": max_rounds, "seed": rec["seed"]}
+            key = fleet_route_key(payload)
+            home = fleet.router.ring.route(key, cordoned)
+            candidates.append((home == kill_result.get("successor"),
+                               key, home, payload))
+        # prefer a record whose key now routes to the cache inheritor
+        # (the submit-time hit); any other victim record still proves
+        # the re-route, just without the inherited-cache hit
+        candidates.sort(key=lambda c: not c[0])
+        if candidates:
+            on_successor, key, home, payload = candidates[0]
+            sub = client.submit(slo=slo_class, priority=slo_class,
+                                **payload)
+            resubmit = {"found_victim_job": True,
+                        "route_key": key,
+                        "routes_to_successor": on_successor,
+                        "routed_home": home,
+                        "cached": bool(sub.get("cached")),
+                        "replica": sub.get("fleet_replica"),
+                        "successor": kill_result.get("successor")}
+        burst.pop("records")
+        drill = {
+            "victim": victim,
+            "victim_drain_exit": kill_result.get("exit"),
+            "successor": kill_result.get("successor"),
+            "device_fault_replica": rf_name,
+            "fault_sites": {"drain": DRAIN_FAULT,
+                            "device": DEVICE_FAULT},
+            "burst": burst,
+            "compiles": drill_warm,
+            "fleet_counters": fleet_diff,
+            "bundles": [os.path.basename(b) for b in bundles],
+            "resubmit_after_death": resubmit,
+        }
+    finally:
+        drain_codes = fleet.stop_all(graceful=True)
+        if tmpdir:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+    by_size = {p["replicas"]: p for p in points}
+    ref = by_size[1]["achieved_rps"] or 1e-9
+    scaling = {str(s): round(by_size[s]["achieved_rps"] / ref, 3)
+               for s in sizes if s != 1}
+    largest = sizes[-1]
+    out = {
+        "metric": f"serve_fleet_scaling_x{largest}",
+        "config": "serve_fleet",
+        # HIGHER IS BETTER: achieved-rps ratio at the largest fleet vs
+        # one replica under weak scaling (offered = N x R0); the gate
+        # on this artifact is history.check_serve_fleet
+        "value": scaling.get(str(largest)),
+        "unit": f"rps scaling at {largest} replicas vs 1 "
+                f"(weak scaling, {rps0:g} rps/replica, "
+                f"mixed buckets, louvain n_p={n_p})",
+        "seconds": round(point_seconds * len(points) + drill_seconds, 3),
+        "converged": True,
+        "n_chips": 1,
+        "mesh": "1x1",
+        "backend": "subprocess-replicas",
+        "telemetry": {
+            "compiles_warm": total_warm,
+            "serve_fleet": {
+                "rps_per_replica": rps0,
+                "slo_class": slo_class,
+                # every replica shares ONE host CPU core: offered load
+                # is N x R0 (weak scaling), so "near-linear" here means
+                # achieved tracks offered as the fleet grows — the
+                # multi-host strong-scaling claim this bench can make
+                # honestly on a single machine
+                "shared_host": True,
+                "buckets": [b.key() for b in buckets],
+                "points": points,
+                "scaling": scaling,
+                "drill": drill,
+                "drain_exit_codes": drain_codes,
+            },
+        },
+    }
+    print(json.dumps(out))
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(out, fh, indent=2)
+            fh.write("\n")
+        print(f"serve_fleet artifact written to {out_path}",
+              file=sys.stderr)
+    ok = (total_warm == 0
+          and all(p["failed"] == 0 and p["stranded"] == 0
+                  and p["rejected_429"] == 0 and p["completed"] > 0
+                  and p["attainment"] == 1.0 for p in points)
+          and all(scaling[str(s)] >= {2: 1.7, 4: 3.0}.get(s, 0.8 * s)
+                  for s in sizes if s != 1)
+          and drill.get("burst", {}).get("failed", 1) == 0
+          and drill.get("burst", {}).get("stranded", 1) == 0
+          and drill.get("victim_drain_exit") == 0
+          and drill.get("fleet_counters", {}).get(
+              "serve.fleet.cordons", 0) >= 1
+          and drill.get("fleet_counters", {}).get(
+              "serve.fleet.rehomed_buckets", 0) >= 1
+          and len(drill.get("bundles", ())) >= 1
+          and drill.get("resubmit_after_death", {}).get("cached") is True
+          and all(c == 0 for c in drain_codes.values()))
+    if not ok:
+        print("serve_fleet: GATE FAILED — see the artifact's points/"
+              "drill blocks", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main() -> int:
     name = os.environ.get("FCTPU_BENCH_CONFIG", "lfr1k")
     if name == "serve_batch":
@@ -985,6 +1448,8 @@ def main() -> int:
         return bench_serve_multichip()
     if name == "serve_load":
         return bench_serve_load()
+    if name == "serve_fleet":
+        return bench_serve_fleet()
     cfg = CONFIGS[name]
     edges, truth, variant = make_graph(cfg)
     if variant:

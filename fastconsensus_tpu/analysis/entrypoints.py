@@ -275,6 +275,17 @@ def entry_points() -> List[EntryPoint]:
     # jittable program; the AST lint and the concurrency pass cover all
     # three, and the watchdog's device-call timing reads arrive through
     # the fclat registry rather than any device sync of its own.
+    # The fcfleet tier (serve/router.py, serve/fleet.py) is host-only
+    # by construction and STRICTLY jax-free (pinned by test with jax
+    # poisoned): the router is stdlib HTTP + a sha1 consistent-hash
+    # ring whose shape classes come from analysis/footprint.grid_up
+    # (the jax-free mirror of the bucketer grid, pinned against it by
+    # test), and the fleet manager only spawns/polls replica
+    # SUBPROCESSES — every device touch happens across an HTTP
+    # boundary in a replica already covered above.  Both register no
+    # entry points; the AST lint walks them and the concurrency pass
+    # verifies the router's single lock discipline (outbound HTTP
+    # deliberately outside the lock).
     assert available()  # registry import sanity
     return eps
 

@@ -37,6 +37,17 @@ latency at the curve's reference RPS — these artifacts are
 lower-is-better, so :func:`check_history` deliberately skips its
 throughput/NMI rules for them (the warm-compile rule still applies).
 
+The fcfleet serve_fleet artifacts (``runs/bench_serve_fleet_rNN.json``,
+written by ``bench.py serve_fleet`` — weak-scaling RPS points over a
+replica fleet plus a chaos-drill block) ride the same reader: records
+keep the block verbatim (``serve_fleet`` in the normalized record),
+:func:`serve_fleet_table` renders the scaling + drill view and
+:func:`check_serve_fleet` gates it — absolute drill-health rules from
+the first artifact, scaling-efficiency trajectory once a same-size
+predecessor exists.  Their headline value is a higher-is-better
+scaling ratio, so :func:`check_history` skips its value rules for
+them too.
+
 The fcqual quality block (``telemetry.quality`` — obs/quality.py's
 :func:`~fastconsensus_tpu.obs.quality.summarize_history` output, stamped
 by ``bench.py`` on every run artifact) rides the same reader: records
@@ -86,6 +97,18 @@ DEFAULT_NMI_DROP = 0.05
 DEFAULT_P95_GROWTH_FRAC = 1.0     # p95 at the reference RPS may double
 DEFAULT_SLO_DROP = 0.15           # absolute attainment drop at ref RPS
 DEFAULT_R429_GROWTH = 0.20        # absolute 429-rate growth at ref RPS
+
+# fcfleet (serve_fleet) gate thresholds.  These artifacts are
+# HIGHER-IS-BETTER scaling ratios (achieved-rps at N replicas vs 1
+# under weak scaling), but ratios taken at different fleet sizes are
+# not one trajectory — check_history skips its value rules for them
+# (the warm-compile rule still applies) and check_serve_fleet owns
+# them, anchored on matching largest fleet size.  The absolute rules
+# (drill health, bundles, inheritance) arm from the FIRST committed
+# artifact: a chaos drill that loses jobs is wrong regardless of
+# history.
+DEFAULT_FLEET_SCALING_DROP = 0.15   # fractional efficiency drop vs median
+DEFAULT_FLEET_ATTAIN_MIN = 0.99     # absolute SLO attainment floor/point
 
 # fcqual (quality-block) gate thresholds.  Same calibration philosophy:
 # loose enough that detector stochasticity (seeded, but the LFR graphs
@@ -170,6 +193,10 @@ def _normalize(rec: dict, source: str, seq: Optional[int]) -> dict:
         # per-RPS latency curve, kept verbatim for serve_load_table()
         # and check_serve_load()
         "serve_load": tel.get("serve_load") or None,
+        # fcfleet serve_fleet artifacts (bench.py serve_fleet): the
+        # weak-scaling points + chaos-drill block, kept verbatim for
+        # serve_fleet_table() and check_serve_fleet()
+        "serve_fleet": tel.get("serve_fleet") or None,
         # fcflight incident-health block (bench.py serve_load): watchdog
         # trips / bundles written / exemplar count, kept verbatim for
         # check_flight() — a clean sequenced load run that TRIPS the
@@ -479,6 +506,191 @@ def check_serve_load(groups: Dict[str, List[dict]],
                         f"RPS ({ref}) grew more than {r429_growth} "
                         f"over the prior median {base:.3f} — the "
                         f"server sheds load it used to serve")
+    return problems
+
+
+def serve_fleet_table(groups: Dict[str, List[dict]],
+                      markdown: bool = False) -> str:
+    """Weak-scaling + chaos-drill tables for configs whose newest
+    record carries a ``serve_fleet`` block (the ``bench.py
+    serve_fleet`` artifacts): per fleet size, the offered/achieved
+    RPS, failure/shed counts, percentiles, SLO attainment, and warm
+    compiles; then a one-row drill summary (victim, drain exit,
+    successor, re-homed groups, bundles, the inherited-cache
+    resubmit).  Empty string when no record has the block."""
+    header = ["replicas", "offered", "achieved", "jobs", "failed",
+              "429s", "p50_ms", "p95_ms", "attain", "compiles"]
+    lines: List[str] = []
+    for config, recs in groups.items():
+        newest = next((r for r in reversed(recs)
+                       if r.get("serve_fleet")), None)
+        if newest is None:
+            continue
+        sf = newest["serve_fleet"]
+        rows = [[_fmt(pt.get("replicas"), 0),
+                 _fmt(pt.get("offered_rps")),
+                 _fmt(pt.get("achieved_rps")),
+                 _fmt(pt.get("completed"), 0),
+                 _fmt(pt.get("failed"), 0),
+                 _fmt(pt.get("rejected_429"), 0),
+                 _fmt(pt.get("p50_ms"), 1), _fmt(pt.get("p95_ms"), 1),
+                 _fmt(pt.get("attainment")),
+                 _fmt(pt.get("compiles"), 0)]
+                for pt in sf.get("points", ())]
+        scaling = ", ".join(f"x{s}={_fmt(v)}" for s, v in
+                            sorted((sf.get("scaling") or {}).items()))
+        lines += _render_rows(
+            f"{config} weak scaling [{newest['source']}; "
+            f"{_fmt(sf.get('rps_per_replica'))} rps/replica; "
+            f"scaling {scaling or '-'}]", header, rows, markdown)
+        drill = sf.get("drill") or {}
+        if drill:
+            burst = drill.get("burst") or {}
+            resub = drill.get("resubmit_after_death") or {}
+            lines += _render_rows(
+                f"{config} chaos drill [{newest['source']}]",
+                ["victim", "drain_exit", "successor", "jobs", "failed",
+                 "replays", "rehomed", "bundles", "resubmit_cached"],
+                [[_fmt(drill.get("victim")),
+                  _fmt(drill.get("victim_drain_exit"), 0),
+                  _fmt(drill.get("successor")),
+                  _fmt(burst.get("completed"), 0),
+                  _fmt(burst.get("failed"), 0),
+                  _fmt((drill.get("fleet_counters") or {}).get(
+                      "serve.fleet.replays"), 0),
+                  _fmt((drill.get("fleet_counters") or {}).get(
+                      "serve.fleet.rehomed_buckets"), 0),
+                  _fmt(len(drill.get("bundles") or ()), 0),
+                  _fmt(resub.get("cached"))]], markdown)
+    return "\n".join(lines).rstrip()
+
+
+def _fleet_efficiency(rec: dict) -> Optional[Tuple[int, float]]:
+    """(largest fleet size, scaling efficiency at it) for one
+    serve_fleet record — efficiency = achieved-rps ratio / size, so
+    records swept to different fleet sizes compare on one axis."""
+    sf = rec.get("serve_fleet") or {}
+    scaling = sf.get("scaling") or {}
+    sizes = [int(s) for s in scaling if scaling[s] is not None]
+    if not sizes:
+        return None
+    largest = max(sizes)
+    return largest, float(scaling[str(largest)]) / largest
+
+
+def check_serve_fleet(groups: Dict[str, List[dict]],
+                      scaling_drop: float = DEFAULT_FLEET_SCALING_DROP,
+                      attain_min: float = DEFAULT_FLEET_ATTAIN_MIN
+                      ) -> List[str]:
+    """fcfleet findings over serve_fleet records; [] means the gate
+    passes.  Two kinds of rule:
+
+    * **Absolute**, armed from the first committed artifact, judged on
+      the newest sequence only: a scaling point that failed/stranded/
+      shed jobs or missed its SLO floor; a chaos drill that lost jobs,
+      whose victim's rolling drain exited non-zero, that re-homed
+      nothing, collected no flight bundle, or whose inherited-cache
+      resubmit came back uncached.  A drill that loses work is wrong
+      no matter what earlier rounds did.
+    * **Trajectory**: the newest sequenced record's scaling efficiency
+      (ratio / fleet size, at its largest size) against the median of
+      sequenced predecessors AT THE SAME largest size — a drop beyond
+      ``scaling_drop`` (fractional) is a finding.  Ratios at different
+      fleet sizes are not one trajectory, same reasoning as
+      check_serve_load's reference-RPS anchor.
+    """
+    problems: List[str] = []
+    for config, recs in groups.items():
+        seqd = [r for r in recs if r["seq"] is not None
+                and r.get("serve_fleet")]
+        if not seqd:
+            continue
+        latest_seq = max(r["seq"] for r in seqd)
+        for r in seqd:
+            if r["seq"] != latest_seq:
+                continue
+            tag = f"{config} [{r['source']} seq {r['seq']}]"
+            sf = r["serve_fleet"]
+            for pt in sf.get("points", ()):
+                n = pt.get("replicas")
+                lost = ((pt.get("failed") or 0)
+                        + (pt.get("stranded") or 0))
+                if lost:
+                    problems.append(
+                        f"{tag}: {lost} job(s) failed/stranded at "
+                        f"fleet size {n} — a healthy fleet under its "
+                        f"offered load must lose nothing")
+                if pt.get("rejected_429"):
+                    problems.append(
+                        f"{tag}: {pt['rejected_429']} submission(s) "
+                        f"shed (429) at fleet size {n} — the router "
+                        f"stopped absorbing the per-replica load it "
+                        f"used to")
+                att = pt.get("attainment")
+                if att is not None and att < attain_min:
+                    problems.append(
+                        f"{tag}: SLO attainment {att:.3f} at fleet "
+                        f"size {n} below the {attain_min} floor")
+            drill = sf.get("drill") or {}
+            if drill:
+                burst = drill.get("burst") or {}
+                lost = ((burst.get("failed") or 0)
+                        + (burst.get("stranded") or 0))
+                if lost:
+                    problems.append(
+                        f"{tag}: the chaos drill lost {lost} job(s) — "
+                        f"re-home + replay must hide a replica death "
+                        f"from clients")
+                drain_exit = drill.get("victim_drain_exit")
+                if drain_exit not in (None, 0):
+                    problems.append(
+                        f"{tag}: the drill victim's rolling drain "
+                        f"exited {drain_exit} — drain must absorb its "
+                        f"armed spill fault and still exit clean")
+                fc = drill.get("fleet_counters") or {}
+                if not fc.get("serve.fleet.rehomed_buckets"):
+                    problems.append(
+                        f"{tag}: the drill re-homed no groups — the "
+                        f"kill either missed live traffic or the "
+                        f"cordon path went dead")
+                if not drill.get("bundles"):
+                    problems.append(
+                        f"{tag}: the drill collected no flight "
+                        f"bundle — the SIGQUIT post-mortem path went "
+                        f"dead")
+                resub = drill.get("resubmit_after_death") or {}
+                if not resub.get("found_victim_job"):
+                    problems.append(
+                        f"{tag}: the drill found no victim-served job "
+                        f"to resubmit — the inheritance demo proved "
+                        f"nothing")
+                elif resub.get("cached") is not True:
+                    problems.append(
+                        f"{tag}: resubmitting a dead replica's job "
+                        f"came back uncached — cache inheritance "
+                        f"(on_death spill load) went dead")
+        # trajectory: efficiency at the newest record's largest size vs
+        # the median of sequenced predecessors at the same size
+        latest = [r for r in seqd if r["seq"] == latest_seq]
+        for r in latest:
+            eff = _fleet_efficiency(r)
+            if eff is None:
+                continue
+            size, latest_eff = eff
+            prior = [e for e in (_fleet_efficiency(p) for p in seqd
+                                 if p["seq"] < latest_seq)
+                     if e is not None and e[0] == size]
+            if not prior:
+                continue
+            base = _median([e for _, e in prior])
+            floor = (1.0 - scaling_drop) * base
+            if latest_eff < floor:
+                tag = f"{config} [{r['source']} seq {r['seq']}]"
+                problems.append(
+                    f"{tag}: scaling efficiency {latest_eff:.3f} at "
+                    f"fleet size {size} fell below {floor:.3f} "
+                    f"({scaling_drop:.0%} drop from the prior median "
+                    f"{base:.3f}) — the fleet stopped scaling")
     return problems
 
 
@@ -951,12 +1163,16 @@ def check_history(groups: Dict[str, List[dict]],
         prior_nmi = [r["nmi"] for r in prior if r["nmi"] is not None]
         for r in latest:
             tag = f"{config} [{r['source']} seq {r['seq']}]"
-            if r.get("serve_load"):
+            if r.get("serve_load") or r.get("serve_fleet"):
                 # latency-curve artifacts are lower-is-better: the
                 # throughput-drop/NMI rules would gate the WRONG
                 # direction (an improvement would "fail").  The tail-
                 # latency gate (check_serve_load) owns them; the
                 # warm-compile retrace rule still applies below.
+                # serve_fleet artifacts are higher-is-better scaling
+                # RATIOS, but ratios taken at different largest fleet
+                # sizes are not one trajectory — check_serve_fleet
+                # owns them, anchored on matching size.
                 if (r["compiles_warm"] or 0) > 0:
                     problems.append(
                         f"{tag}: {r['compiles_warm']} warm-run "

@@ -70,6 +70,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "loaded at startup, spilled (npz) on graceful "
                         "drain — a restarted server answers repeats of "
                         "pre-restart work without touching the device")
+    p.add_argument("--cache-spill-s", type=float, default=None,
+                   metavar="SECONDS",
+                   help="ALSO spill --cache-file every SECONDS while "
+                        "serving (skipped when nothing changed) so a "
+                        "crashed replica's cache survives for fleet "
+                        "inheritance (serve/fleet.py); default: drain-"
+                        "time only")
     p.add_argument("--devices", type=int, default=None, metavar="N",
                    help="drive N local devices with one worker each "
                         "(default: all of them; 1 = single-worker). "
@@ -195,6 +202,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.max_batch < 1:
         print("error: --max-batch must be >= 1", file=sys.stderr)
         return 2
+    if args.cache_spill_s is not None:
+        if args.cache_spill_s <= 0:
+            print("error: --cache-spill-s must be > 0", file=sys.stderr)
+            return 2
+        if not args.cache_file:
+            print("error: --cache-spill-s needs --cache-file (there is "
+                  "nowhere to spill to)", file=sys.stderr)
+            return 2
     chip_max_edges = args.chip_max_edges
     if isinstance(chip_max_edges, str):
         if chip_max_edges.lower() == "auto":
@@ -301,6 +316,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                       trace_dir=args.trace_dir,
                       max_batch=args.max_batch,
                       cache_path=args.cache_file,
+                      cache_spill_s=args.cache_spill_s,
                       prewarm=tuple(args.warm),
                       prewarm_config=warm_config,
                       devices=args.devices,

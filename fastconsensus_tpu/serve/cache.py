@@ -46,6 +46,12 @@ class ResultCache:
         # key -> (stored_at, value); OrderedDict end = most recent
         self._entries: "OrderedDict[str, Tuple[float, Any]]" = OrderedDict()
         self._reg = obs_counters.get_registry()
+        # Spill coordination (fcfleet): the periodic background spill
+        # and the drain-time spill may race; one coarse lock serializes
+        # the npz write, and the dirty flag lets the loser skip instead
+        # of rewriting identical bytes (spill_if_dirty).
+        self._spill_lock = threading.Lock()
+        self._dirty = False
 
     def get(self, key: str, count_miss: bool = True) -> Optional[Any]:
         """The cached result, or None (counts hit/miss/expired).
@@ -82,6 +88,7 @@ class ResultCache:
 
     def put(self, key: str, value: Any) -> None:
         with self._lock:
+            self._dirty = True
             self._entries[key] = (self._clock(), value)
             self._entries.move_to_end(key)
             self._reg.inc("serve.cache.insert")
@@ -113,13 +120,41 @@ class ResultCache:
         atomic); returns the number spilled.  Entries whose payload is
         not the standard result shape (a dict with a ``partitions``
         array list and JSON scalars) are skipped with a counter — the
-        spill must never fail the drain that triggers it."""
+        spill must never fail the drain that triggers it.  Serialized
+        against concurrent spills (blocking): the drain-time spill and
+        the fcfleet periodic spill share one atomic-write path."""
+        with self._spill_lock:
+            return self._spill_locked(path)
+
+    def spill_if_dirty(self, path: str) -> int:
+        """The fcfleet periodic-spill entry (serve/server.py
+        ``--cache-spill-s`` loop): spill only when entries changed
+        since the last spill, and never while another spill is already
+        writing — returns -1 when skipped because a concurrent spill
+        holds the lock (counted), 0 when clean, else the number
+        spilled.  This is what keeps a SIGKILLed replica's cache
+        recoverable (serve/fleet.py ``on_death`` feeds the file to the
+        ring successor) without the drain-time spill ever racing it
+        into a double write."""
+        if not self._spill_lock.acquire(blocking=False):
+            self._reg.inc("serve.cache.persist_concurrent_skip")
+            return -1
+        try:
+            with self._lock:
+                if not self._dirty:
+                    return 0
+            return self._spill_locked(path)
+        finally:
+            self._spill_lock.release()
+
+    def _spill_locked(self, path: str) -> int:
         import json
 
         import numpy as np
 
         now = self._clock()
         with self._lock:
+            self._dirty = False
             items = [(k, t, v) for k, (t, v) in self._entries.items()]
         meta, arrays = [], {}
         for key, stored_at, value in items:
@@ -194,6 +229,11 @@ class ResultCache:
                 path, e)
             return 0
         with self._lock:
+            if loaded:
+                # loaded entries count as un-spilled content: a replica
+                # that inherits a dead sibling's cache must re-spill it
+                # on its own schedule or lose it at its own crash
+                self._dirty = True
             self._reg.gauge("serve.cache.entries", len(self._entries))
         self._reg.inc("serve.cache.persist_loaded", loaded)
         return loaded

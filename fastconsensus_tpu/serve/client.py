@@ -267,6 +267,94 @@ class SlowJobExemplar:
                    else JobTiming.from_payload(t))
 
 
+@dataclasses.dataclass(frozen=True)
+class ReplicaState:
+    """One replica's row in the fcfleet router's ``/healthz`` /
+    ``/metricsz`` fleet block (serve/router.py), typed: where the
+    replica lives, whether the router routes to it (``state`` is
+    ``"up"`` or ``"cordoned"``, with the cordon reason when set), the
+    last polled queue depth pair the saturation routing reads, and the
+    replica's own health self-reports (draining flag, watchdog trip
+    count, freshest flight-bundle path) as the router last saw them."""
+
+    name: str
+    url: str
+    state: str
+    cordon_reason: Optional[str]
+    poll_failures: int
+    queue_depth: int
+    queue_max_depth: int
+    draining: bool
+    watchdog_trips: Optional[int]
+    retry_after_hint_s: Optional[float]
+    last_bundle: Optional[str]
+    # route keys this replica owned when it was cordoned (empty while
+    # up): the frozen snapshot successor election reads, surfaced so a
+    # post-mortem can see exactly which groups a dead replica donated
+    rehomed_keys: Tuple[str, ...] = ()
+
+    @property
+    def cordoned(self) -> bool:
+        return self.state == "cordoned"
+
+    @classmethod
+    def from_payload(cls, r: Dict[str, Any]) -> "ReplicaState":
+        trips = r.get("watchdog_trips")
+        hint = r.get("retry_after_hint_s")
+        return cls(name=str(r["name"]), url=str(r["url"]),
+                   state=str(r["state"]),
+                   cordon_reason=r.get("cordon_reason"),
+                   poll_failures=int(r.get("poll_failures", 0)),
+                   queue_depth=int(r.get("queue_depth", 0)),
+                   queue_max_depth=int(r.get("queue_max_depth", 0)),
+                   draining=bool(r.get("draining", False)),
+                   watchdog_trips=None if trips is None else int(trips),
+                   retry_after_hint_s=None if hint is None
+                   else float(hint),
+                   last_bundle=r.get("last_bundle"),
+                   rehomed_keys=tuple(
+                       str(k) for k in r.get("rehomed_keys") or ()))
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetStats:
+    """The fcfleet router's fleet block, typed: per-replica states,
+    the consistent-hash ring membership, the route-key -> replica
+    assignment table the re-home accounting runs on, in-flight router
+    bookkeeping, and the ``serve.fleet.*`` counters (cordons /
+    re-homed groups / replays / cross-replica cache traffic)."""
+
+    replicas: Tuple[ReplicaState, ...]
+    ring_members: Tuple[str, ...]
+    vnodes: int
+    assignments: Dict[str, str]
+    jobs_tracked: int
+    jobs_in_flight: int
+    content_hash_index: int
+    counters: Dict[str, int]
+
+    @property
+    def up(self) -> Tuple[ReplicaState, ...]:
+        return tuple(r for r in self.replicas if r.state == "up")
+
+    @classmethod
+    def from_payload(cls, f: Dict[str, Any]) -> "FleetStats":
+        ring = f.get("ring") or {}
+        return cls(replicas=tuple(ReplicaState.from_payload(r)
+                                  for r in f.get("replicas") or ()),
+                   ring_members=tuple(str(m) for m in
+                                      ring.get("members") or ()),
+                   vnodes=int(ring.get("vnodes", 0)),
+                   assignments={str(k): str(v) for k, v in
+                                (f.get("assignments") or {}).items()},
+                   jobs_tracked=int(f.get("jobs_tracked", 0)),
+                   jobs_in_flight=int(f.get("jobs_in_flight", 0)),
+                   content_hash_index=int(
+                       f.get("content_hash_index", 0)),
+                   counters={str(k): int(v) for k, v in
+                             (f.get("counters") or {}).items()})
+
+
 # What Backpressure.retry_after_s reports when the server sent no (or a
 # malformed) Retry-After — the pre-fcshape constant, kept as the
 # honest "we know nothing" floor.
@@ -473,6 +561,48 @@ class ServeClient:
             "queue_coalesced_pops": counters.get(
                 "serve.queue.coalesced_pops", 0),
         }
+
+    def fleet(self) -> Optional[FleetStats]:
+        """The fcfleet block, typed, when ``base_url`` points at a
+        router (serve/router.py) — None against a plain replica, so a
+        caller can probe what it is talking to."""
+        f = self.healthz().get("fleet")
+        return None if f is None else FleetStats.from_payload(f)
+
+    def retry(self, call, attempts: int = 6, backoff: float = 1.5,
+              jitter_frac: float = 0.1, max_sleep_s: float = 30.0,
+              sleep=time.sleep, rng=None) -> Any:
+        """Run ``call()`` (any zero-arg client operation, e.g.
+        ``lambda: c.submit(...)``) with backpressure retries: each
+        :class:`Backpressure` sleeps the server's TYPED
+        ``retry_after_s`` — the shaping stack derived it from queued
+        depth x observed service rate, so honoring it converges on the
+        actual drain time — scaled by ``backoff ** attempt`` (a still-
+        shedding server earns growing patience) plus up to
+        ``jitter_frac`` random jitter (synchronized clients all
+        retrying at exactly the hinted instant would arrive as one
+        thundering herd and shed each other again).  The final
+        Backpressure re-raises; non-429 errors propagate immediately.
+        ``sleep``/``rng`` are injectable for deterministic tests."""
+        if attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {attempts}")
+        if backoff < 1.0:
+            raise ValueError(f"backoff must be >= 1.0, got {backoff}")
+        if rng is None:
+            import random
+
+            rng = random.Random()
+        for attempt in range(attempts):
+            try:
+                return call()
+            except Backpressure as e:
+                if attempt == attempts - 1:
+                    raise
+                delay = min(max_sleep_s,
+                            e.retry_after_s * (backoff ** attempt))
+                delay += rng.uniform(0.0, jitter_frac * delay)
+                sleep(delay)
+        raise AssertionError("unreachable")  # the loop returns or raises
 
     def wait(self, job_id: str, timeout: float = 300.0,
              poll_s: float = 0.2) -> Dict[str, Any]:

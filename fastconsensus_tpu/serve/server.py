@@ -142,6 +142,14 @@ class ServeConfig:
     # A restarted server answers repeats of pre-restart work as cache
     # hits without touching the device.  None = in-memory only.
     cache_path: Optional[str] = None
+    # fcfleet: ALSO spill the cache every this-many seconds while
+    # serving (ResultCache.spill_if_dirty — skipped when nothing
+    # changed, never concurrent with the drain spill).  A drain-only
+    # spill means a SIGKILLed replica's cache dies with it; the
+    # periodic spill is what lets a fleet successor inherit it
+    # (serve/fleet.py on_death -> POST /cachez/load).  Requires
+    # cache_path; None/0 disables (the pre-fcfleet posture).
+    cache_spill_s: Optional[float] = None
     # Pre-warm bucket specs ("n64_e96" or "n64_e96:4"): before serving,
     # the worker compiles each bucket's solo executables and its batch
     # ladder up to the given rung (default: max_batch) by driving
@@ -265,6 +273,10 @@ class ConsensusService:
         self._hang_after = int(os.environ.get("FCTPU_TEST_HANG_AFTER",
                                               "0") or 0)
         self._hang_seq = itertools.count()
+        # fcfleet periodic cache spill (cache_spill_s): stopped by
+        # drain() before the final drain-time spill
+        self._spill_stop = threading.Event()
+        self._spill_thread: Optional[threading.Thread] = None
 
     # -- lifecycle ---------------------------------------------------
 
@@ -297,6 +309,11 @@ class ConsensusService:
             n = self.cache.load(self.config.cache_path)
             _logger.info("fcserve: reloaded %d cached result(s) from %s",
                          n, self.config.cache_path)
+        if self.config.cache_path and self.config.cache_spill_s:
+            self._spill_thread = threading.Thread(
+                target=self._spill_loop, name="fcserve-cache-spill",
+                daemon=True)
+            self._spill_thread.start()
         from fastconsensus_tpu.serve.pool import WorkerPool
 
         self.pool = WorkerPool(self)
@@ -319,6 +336,26 @@ class ConsensusService:
                 lambda key: pool._is_huge(bucketer.bucket_from_key(key)))
         return self
 
+    def _spill_loop(self) -> None:
+        """fcfleet periodic cache persistence (``cache_spill_s``): the
+        crash-survival complement to the drain-time spill — a replica
+        killed without a drain still leaves a recent npz for the ring
+        successor to inherit.  ``spill_if_dirty`` makes the idle loop
+        free (no write when nothing changed) and yields to a
+        concurrent drain spill instead of double-writing."""
+        while not self._spill_stop.wait(self.config.cache_spill_s):
+            try:
+                n = self.cache.spill_if_dirty(self.config.cache_path)
+                if n > 0:
+                    _logger.debug("fcserve: periodic spill wrote %d "
+                                  "cached result(s)", n)
+            except OSError:
+                # same contract as the drain spill: persistence is an
+                # optimization and a full disk must not kill serving
+                self._reg.inc("serve.cache.persist_write_failed")
+                _logger.exception(
+                    "fcserve: periodic cache spill failed; continuing")
+
     def begin_drain(self) -> None:
         """Stop admissions; already-admitted jobs keep running."""
         self.queue.close()
@@ -328,6 +365,14 @@ class ConsensusService:
         every worker, export ONE merged trace with per-device tracks
         (``trace_dir``).  True = fully drained."""
         self.begin_drain()
+        # stop the periodic spill loop BEFORE the final spill below: the
+        # drain-time write must be the last one (spill_if_dirty would
+        # skip on the shared lock anyway, but a loop outliving drain
+        # could resurrect the file after an operator removed it)
+        self._spill_stop.set()
+        if self._spill_thread is not None:
+            self._spill_thread.join(timeout=5.0)
+            self._spill_thread = None
         ok = True
         if self.pool is not None:
             ok = self.pool.drain(timeout if timeout is not None
@@ -1219,6 +1264,43 @@ class ConsensusService:
 
     # -- introspection -----------------------------------------------
 
+    # -- fcfleet cross-replica cache surface ---------------------------
+
+    def cache_entry(self, key: str) -> Optional[Dict[str, Any]]:
+        """The cached result for one content hash, or None — the
+        ``GET /cachez/<hash>`` read path a fleet sibling fetches on
+        miss (serve/router.py).  Counts as a cache hit: it IS a serve,
+        just answered one replica over."""
+        return self.cache.get(key, count_miss=False)
+
+    def cache_seed(self, payload: Dict[str, Any]) -> str:
+        """Insert one wire-shape result (``POST /cachez``) into the
+        local cache, so an already-queued job for the same content
+        completes via the worker's pre-run re-probe with zero device
+        work — the receiving half of fleet fetch-on-miss and prewarm
+        cache shipping.  Raises ValueError on a payload that is not
+        the standard result shape."""
+        key = payload.get("content_hash")
+        parts = payload.get("partitions")
+        if not isinstance(key, str) or not key or \
+                not isinstance(parts, (list, tuple)) or not parts:
+            raise ValueError(
+                "cache seed needs content_hash + partitions")
+        value = dict(payload)
+        # per-SUBMISSION fields never ride cached content (the same
+        # rule /result applies when attaching them)
+        value.pop("timing", None)
+        value["partitions"] = [np.asarray(p, dtype=np.int32)
+                               for p in parts]
+        if any(p.ndim != 1 for p in value["partitions"]):
+            raise ValueError("partitions must be 1-D label arrays")
+        # stored uncached; a later hit serves dict(value, cached=True)
+        # exactly like a locally computed result
+        value["cached"] = False
+        self.cache.put(key, value)
+        self._reg.inc("serve.cache.seeded")
+        return key
+
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             states: Dict[str, int] = {}
@@ -1434,13 +1516,47 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 — catch-all status mapping
             self._send_fault(e)
 
+    def _read_json(self) -> Dict[str, Any]:
+        length = int(self.headers.get("Content-Length", "0"))
+        return json.loads(self.rfile.read(length) or b"{}")
+
     def _do_post(self) -> None:
-        if self.path.rstrip("/") != "/submit":
+        path = self.path.rstrip("/")
+        if path == "/cachez":
+            # fcfleet cache seeding: a router (fetch-on-miss) or the
+            # fleet manager (prewarm shipping) plants a sibling's
+            # result here
+            try:
+                key = self.service.cache_seed(self._read_json())
+            except (ValueError, TypeError, KeyError) as e:
+                self._send(400, {"error": f"bad cache seed: {e}"})
+                return
+            self._send(200, {"seeded": True, "content_hash": key,
+                             "cache_entries": len(self.service.cache)})
+            return
+        if path == "/cachez/load":
+            # fcfleet death inheritance: load a dead sibling's spilled
+            # npz (serve/fleet.py on_death) — corrupt/missing files
+            # load 0 entries, never error (the ResultCache.load
+            # contract)
+            try:
+                spill_path = str(self._read_json()["path"])
+            except (ValueError, TypeError, KeyError) as e:
+                self._send(400, {"error": f"bad cache load request: {e}"})
+                return
+            before = set(self.service.cache.keys())
+            n = self.service.cache.load(spill_path)
+            # the hashes that are new here, so the router can index this
+            # replica as their holder (fetch-on-miss after inheritance)
+            fresh = [k for k in self.service.cache.keys()
+                     if k not in before]
+            self._send(200, {"loaded": n, "content_hashes": fresh})
+            return
+        if path != "/submit":
             self._send(404, {"error": f"no such endpoint {self.path}"})
             return
         try:
-            length = int(self.headers.get("Content-Length", "0"))
-            payload = json.loads(self.rfile.read(length) or b"{}")
+            payload = self._read_json()
             spec = _parse_spec(payload, self.service.config.max_edges)
         except GraphTooLarge as e:
             self._send(413, {"error": str(e)})
@@ -1505,6 +1621,20 @@ class _Handler(BaseHTTPRequestHandler):
             # fcflight tail exemplars: the bucket-worst serve.e2e jobs
             # joined to their flight timelines (typed in ServeClient)
             self._send(200, self.service.slowest())
+            return
+        if path == "/cachez":
+            # fcfleet: the content-hash index a prewarm-shipping donor
+            # advertises (serve/fleet.py ship_cache)
+            self._send(200, {"keys": self.service.cache.keys(),
+                             "entries": len(self.service.cache)})
+            return
+        if path.startswith("/cachez/"):
+            cached = self.service.cache_entry(path[len("/cachez/"):])
+            if cached is None:
+                self._send(404, {"error": "no cached result for that "
+                                          "content hash"})
+            else:
+                self._send(200, _result_json(dict(cached, cached=True)))
             return
         for prefix in ("/status/", "/result/"):
             if path.startswith(prefix):

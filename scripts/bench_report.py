@@ -21,7 +21,7 @@ transport where jax init hangs, the gate must still run.
 
 ``--check`` additionally validates every metric key this gate reads
 against the committed fcheck-contract inventory
-(``runs/contract_r14.json``) before judging anything: a gate reading a
+(``runs/contract_r17.json``) before judging anything: a gate reading a
 renamed counter is vacuously green forever, so phantom keys fail fast
 with exit 2.  ``fastconsensus_tpu.analysis.contracts`` is safe to
 import here — the package ``__init__`` is lazy and the analysis layer
@@ -88,7 +88,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="emit the trend report as markdown tables")
     p.add_argument("--inventory", metavar="PATH",
                    default=os.path.join(REPO, "runs",
-                                        "contract_r14.json"),
+                                        "contract_r17.json"),
                    help="fcheck-contract inventory artifact; with "
                         "--check, every metric key this gate reads is "
                         "validated against it at startup so a renamed "
@@ -164,6 +164,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             # change shows its mechanism (queue-wait vs device time)
             print()
             print(serve_load)
+        serve_fleet = history.serve_fleet_table(groups,
+                                                markdown=args.markdown)
+        if serve_fleet:
+            # fcfleet weak-scaling + chaos-drill view (bench.py
+            # serve_fleet): achieved RPS per fleet size plus the
+            # kill-drill summary (re-home, bundles, cache inheritance)
+            print()
+            print(serve_fleet)
         quality = history.quality_table(groups, markdown=args.markdown)
         if quality:
             # fcqual convergence-quality blocks (obs/quality.py): rounds
@@ -192,6 +200,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # the fclat tail-latency gate (lower-is-better artifacts the
     # throughput rule above deliberately skips)
     problems += history.check_serve_load(groups)
+    # the fcfleet scaling + chaos-drill gate (absolute drill health,
+    # scaling-efficiency trajectory at matching fleet size)
+    problems += history.check_serve_fleet(groups)
     # the fcqual partition-quality gate (rounds-to-converge growth,
     # agreement drop, late-frontier growth)
     problems += history.check_quality(groups)

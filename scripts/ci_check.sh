@@ -245,16 +245,16 @@ if [ $rc -ne 0 ]; then
 fi
 
 echo "== fcheck-contract: committed inventory & README appendix drift =="
-# the committed runs/contract_r14.json and the README counters
+# the committed runs/contract_r17.json and the README counters
 # reference are both generated from the writer inventory; regenerate
 # each and diff so a new counter cannot land without refreshing them
 JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
     fastconsensus_tpu/ --no-jaxpr --quiet \
     --emit-inventory /tmp/fc_contract_inv.json
-if ! diff -u runs/contract_r14.json /tmp/fc_contract_inv.json; then
-    echo "runs/contract_r14.json is stale — regenerate with" \
+if ! diff -u runs/contract_r17.json /tmp/fc_contract_inv.json; then
+    echo "runs/contract_r17.json is stale — regenerate with" \
          "python -m fastconsensus_tpu.analysis fastconsensus_tpu/" \
-         "--no-jaxpr --emit-inventory runs/contract_r14.json" >&2
+         "--no-jaxpr --emit-inventory runs/contract_r17.json" >&2
     exit 1
 fi
 JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
@@ -408,11 +408,11 @@ snapshot = client.metricsz()
 json.dumps(snapshot)          # /metricsz stays JSON end to end
 # ISSUE 14 runtime cross-check: every metric name the LIVE server
 # emits after real traffic must union cleanly with the committed
-# static writer inventory (runs/contract_r14.json) — closes the
+# static writer inventory (runs/contract_r17.json) — closes the
 # static-model-vs-reality loop for the contract pass
 from fastconsensus_tpu.analysis import contracts
 
-n_checked = contracts.assert_covered(snapshot, "runs/contract_r14.json")
+n_checked = contracts.assert_covered(snapshot, "runs/contract_r17.json")
 print(f"fcserve smoke ok: cache hit served, {rejected} burst "
       f"rejection(s), {len(accepted)} burst job(s) completed, "
       f"{n_checked} live metric name(s) covered by the inventory")
@@ -1304,24 +1304,24 @@ fi
 echo "fcflight smoke ok: cordon-on-stall, SIGQUIT dump, reader round-trip"
 
 echo "== fcfault: injection-site inventory drift =="
-# runs/faults_r15.json is generated from the fault pass's raise-set
+# runs/faults_r17.json is generated from the fault pass's raise-set
 # analysis; regenerate and diff so a new raise site (or a moved
 # boundary) cannot land without refreshing the committed claims the
 # injection campaign below tests against
 JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
     fastconsensus_tpu/ --no-jaxpr --quiet \
     --emit-fault-inventory /tmp/fc_fault_inv.json
-if ! diff -u runs/faults_r15.json /tmp/fc_fault_inv.json; then
-    echo "runs/faults_r15.json is stale — regenerate with" \
+if ! diff -u runs/faults_r17.json /tmp/fc_fault_inv.json; then
+    echo "runs/faults_r17.json is stale — regenerate with" \
          "python -m fastconsensus_tpu.analysis fastconsensus_tpu/" \
-         "--no-jaxpr --emit-fault-inventory runs/faults_r15.json" >&2
+         "--no-jaxpr --emit-fault-inventory runs/faults_r17.json" >&2
     exit 1
 fi
 echo "fault inventory in sync with the raise-set analysis"
 
 echo "== fcfault: 3-site injection campaign (queue / device / drain path) =="
 # Every site's statically claimed absorbing boundary
-# (runs/faults_r15.json) is tested against a LIVE loopback pool: the
+# (runs/faults_r17.json) is tested against a LIVE loopback pool: the
 # injected job fails as itself, failure counters are stamped, sibling
 # jobs complete, and SIGTERM drain still exits 0.
 FAULT_DIR=$(mktemp -d)
@@ -1428,6 +1428,167 @@ PYEOF
     fi
 done
 echo "fcfault campaign ok: 3 sites injected, every boundary held, drains clean"
+
+echo "== fcfleet: 3-replica drill (kill mid-burst, re-home, cache inheritance) =="
+FLEET_DIR=$(mktemp -d)
+trap 'rm -rf "$SMOKE_DIR" "$SERVE_DIR" "$BATCH_DIR" "$POOL_DIR" "$FAULT_DIR" "$FLEET_DIR"; [ -n "$SERVE_PID" ] && kill -9 "$SERVE_PID" 2>/dev/null' EXIT
+# a live three-replica loopback fleet with the drain-time disk-full
+# fault armed in the ring owner of the first bucket (the ring is a
+# pure function of the member names, so the victim is known before any
+# process starts); the victim dies mid-burst and the stage pins the
+# whole failover story: rolling drain exits 0 under the armed fault,
+# the client sees zero failed/stranded jobs, the cordon re-homes the
+# victim's groups, and resubmitting a job the corpse served comes back
+# as a submit-time cache hit from the inherited spill on a live replica
+JAX_PLATFORMS=cpu timeout -k 10 600 python - "$FLEET_DIR" <<'PYEOF'
+import json
+import sys
+import threading
+import time
+
+from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.serve import bucketer
+from fastconsensus_tpu.serve.client import JobFailed, ServeClient
+from fastconsensus_tpu.serve.fleet import FleetManager
+from fastconsensus_tpu.serve.router import HashRing, route_key
+
+workdir = sys.argv[1]
+DRAIN_FAULT = "fastconsensus_tpu.serve.cache:ResultCache.spill:OSError"
+
+buckets = [bucketer.bucket_for(64, e) for e in (64, 96, 128, 192)]
+edges = [bucketer.probe_edges(b).tolist() for b in buckets]
+
+
+def payload(bi, seed):
+    return {"edges": edges[bi], "n_nodes": buckets[bi].n_class,
+            "algorithm": "louvain", "n_p": 2, "max_rounds": 2,
+            "seed": seed}
+
+
+keys = [route_key(payload(bi, 0)) for bi in range(len(buckets))]
+names = ["r0", "r1", "r2"]
+victim = HashRing(names).route(keys[0])
+
+fleet = FleetManager(
+    workdir, warm=tuple(f"{b.key()}:1" for b in buckets),
+    replica_args=("--max-batch", "1", "--queue-depth", "64",
+                  "--warm-config",
+                  json.dumps({"n_p": 2, "max_rounds": 2}), "--quiet"),
+    cache_spill_s=0.5, poll_s=0.25)
+try:
+    for name in names:
+        fleet.spawn(name, fault=DRAIN_FAULT if name == victim else None,
+                    fault_count=1 if name == victim else None)
+    client = ServeClient(fleet.start_router(), timeout=30.0)
+
+    # phase 1: two seeds per bucket, fully drained, so the victim owns
+    # AND has served groups whose results its periodic spill persists
+    records = []
+    for seed in (1, 2):
+        for bi in range(len(buckets)):
+            sub = client.submit(**payload(bi, seed))
+            client.wait(sub["job_id"], timeout=120)
+            records.append((keys[bi], payload(bi, seed),
+                            sub.get("fleet_replica")))
+    assert any(rep == victim for _, _, rep in records), \
+        f"ring precompute lied: {victim} served nothing"
+    # >=3 spill cycles: the armed shot eats the first dirty spill, the
+    # next one persists the victim's results for inheritance
+    time.sleep(1.6)
+
+    # phase 2: kill the victim mid-burst; cordon + re-home + replay
+    # must hide the death from the submitting client entirely
+    exit_box = {}
+
+    def killer():
+        time.sleep(0.3)
+        exit_box["exit"] = fleet.kill(victim, graceful=True)
+
+    t = threading.Thread(target=killer)
+    t.start()
+    job_ids = []
+    for i, bi in enumerate([0, 1, 2, 3, 0, 1]):
+        job_ids.append(client.submit(**payload(bi, 10 + i))["job_id"])
+        time.sleep(0.15)
+    t.join(150.0)
+    failed = 0
+    pending = set(job_ids)
+    deadline = time.monotonic() + 120.0
+    while pending and time.monotonic() < deadline:
+        for jid in list(pending):
+            try:
+                res = client.result(jid)
+            except JobFailed:
+                failed += 1
+                pending.discard(jid)
+                continue
+            except Exception:  # noqa: BLE001 — transient poll error;
+                # the job stays pending and the deadline is the gate
+                continue
+            if "partitions" in res:
+                pending.discard(jid)
+        time.sleep(0.05)
+    assert failed == 0, f"{failed} job(s) failed across the kill"
+    assert not pending, f"{len(pending)} job(s) stranded after 120s"
+    assert exit_box.get("exit") == 0, \
+        f"victim drain exited {exit_box.get('exit')} under armed fault"
+
+    successor = fleet.on_death(victim)
+    assert successor and successor != victim, successor
+    fc = {k: v for k, v in obs_counters.get_registry().counters().items()
+          if k.startswith("serve.fleet.")}
+    assert fc.get("serve.fleet.cordons", 0) >= 1, fc
+    assert fc.get("serve.fleet.rehomed_buckets", 0) >= 1, fc
+
+    # phase 3: a job the dead victim served, whose group now routes to
+    # the successor, must come back as a submit-time cache hit from
+    # the inherited spill — served by a live replica
+    stats = fleet.router.fleet_stats()
+    cordoned = frozenset(r["name"] for r in stats["replicas"]
+                         if r["state"] == "cordoned")
+    resub = None
+    for key, pay, rep in records:
+        if rep == victim and fleet.router.ring.route(
+                key, cordoned) == successor:
+            resub = client.submit(**pay)
+            break
+    assert resub is not None, \
+        "no victim-served group re-homed to the successor"
+    assert resub.get("cached") is True, resub
+    assert resub.get("fleet_replica") not in (None, victim), resub
+finally:
+    fleet.stop_all()
+print("fcfleet drill ok: drain 0, zero failed, re-home counted, "
+      "inherited-cache hit on resubmit")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcfleet drill failed (exit $rc)" >&2
+    exit $rc
+fi
+# negative probe: a copy whose chaos drill lost jobs, sequenced one
+# later, must FAIL check_serve_fleet naming the drill rule (a gate
+# that can't fail is no gate)
+python - runs/bench_serve_fleet_r17.json \
+    "$FLEET_DIR/bench_serve_fleet_r99.json" <<'PYEOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+doc["telemetry"]["serve_fleet"]["drill"]["burst"]["failed"] = 3
+json.dump(doc, open(sys.argv[2], "w"))
+PYEOF
+out=$(python scripts/bench_report.py --check --quiet \
+    runs/bench_serve_fleet_r17.json \
+    "$FLEET_DIR/bench_serve_fleet_r99.json" 2>&1)
+rc=$?
+if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "chaos drill lost"; then
+    echo "drill-regressed serve_fleet copy did not fail the gate" \
+         "(exit $rc):" >&2
+    echo "$out" >&2
+    exit 1
+fi
+echo "serve_fleet gate ok: drill-regressed copy fails naming the drill rule"
 
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"

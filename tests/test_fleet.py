@@ -1,0 +1,360 @@
+"""fcfleet: consistent-hash ring, router forwarding, typed client
+stats, and the cache-persistence pins the fleet's death-inheritance
+path rides on (serve/router.py, serve/fleet.py, serve/client.py,
+serve/cache.py)."""
+
+import math
+import os
+import random
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+# -- HashRing ----------------------------------------------------------
+
+
+def _keys(n):
+    return [f"bucket-{i:04d}" for i in range(n)]
+
+
+def test_ring_join_moves_at_most_a_fair_share():
+    """Consistent hashing's whole point: a joiner takes ~1/(N+1) of the
+    keyspace, and NOTHING else moves — every re-homed key moves TO the
+    joiner.  At the default vnode count the movement must stay within
+    the fair share ceil(B/(N+1)) for every probed keyspace size."""
+    from fastconsensus_tpu.serve.router import DEFAULT_VNODES, HashRing
+
+    members = ("r0", "r1", "r2")
+    for n_keys in (120, 200, 256):
+        keys = _keys(n_keys)
+        ring = HashRing(members, vnodes=DEFAULT_VNODES)
+        before = {k: ring.route(k) for k in keys}
+        ring.add("r3")
+        after = {k: ring.route(k) for k in keys}
+        moved = {k for k in keys if before[k] != after[k]}
+        cap = math.ceil(n_keys / (len(members) + 1))
+        assert len(moved) <= cap, (
+            f"{len(moved)} of {n_keys} keys moved on join; "
+            f"fair-share cap is {cap}")
+        assert all(after[k] == "r3" for k in moved), \
+            "a join must only move keys TO the joiner"
+
+
+def test_ring_exclusion_rehomes_minimally_and_is_reversible():
+    """Cordon = exclusion at lookup, not ring surgery: only the
+    excluded member's keys move (to ring successors), and lifting the
+    exclusion restores every original home — recovery must not
+    trigger a second re-home."""
+    from fastconsensus_tpu.serve.router import HashRing, NoEligibleReplica
+
+    ring = HashRing(("a", "b", "c"))
+    keys = _keys(150)
+    before = {k: ring.route(k) for k in keys}
+    excluded = frozenset({"b"})
+    for k in keys:
+        owner = ring.route(k, excluded)
+        assert owner != "b"
+        if before[k] != "b":
+            assert owner == before[k], \
+                "exclusion moved a key the excluded member never owned"
+    assert {ring.route(k) for k in keys} == {before[k] for k in keys}
+    assert all(ring.route(k) == before[k] for k in keys)
+    with pytest.raises(NoEligibleReplica):
+        ring.route("anything", frozenset({"a", "b", "c"}))
+
+
+def test_ring_preview_owner_names_the_donor():
+    """preview_owner must name the CURRENT owner of exactly the keys a
+    joiner would take (the prewarm-shipping donor), and None for keys
+    that stay put."""
+    from fastconsensus_tpu.serve.router import HashRing
+
+    ring = HashRing(("a", "b", "c"))
+    keys = _keys(200)
+    before = {k: ring.route(k) for k in keys}
+    trial = HashRing(("a", "b", "c", "d"), vnodes=ring.vnodes)
+    for k in keys:
+        donor = ring.preview_owner(k, "d")
+        if trial.route(k) == "d":
+            assert donor == before[k]
+        else:
+            assert donor is None
+
+
+def test_ring_placement_is_cross_process_deterministic():
+    """Two routers (two PROCESSES) with the same member set must agree
+    on every placement — the ring must be sha1-stable, never
+    PYTHONHASHSEED-dependent.  The child also runs with jax poisoned:
+    the ring is part of the jax-free router tier."""
+    from fastconsensus_tpu.serve.router import HashRing
+
+    members = ("r0", "r1", "r2", "r3")
+    keys = _keys(64)
+    local = [HashRing(members).route(k) for k in keys]
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "from fastconsensus_tpu.serve.router import HashRing\n"
+        f"ring = HashRing({members!r})\n"
+        f"print(';'.join(ring.route(k) for k in {keys!r}))\n")
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT, PYTHONHASHSEED="77")
+    res = subprocess.run([sys.executable, "-c", code], cwd=REPO_ROOT,
+                         env=env, capture_output=True, text=True,
+                         timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.strip().split(";") == local
+
+
+# -- route_key ---------------------------------------------------------
+
+
+def test_route_key_matches_bucketer_grid_and_ignores_seed():
+    """route_key's shape classes must agree with the grid the replica
+    actually pads onto (serve/bucketer.py) — affinity that disagrees
+    with bucketing warms every bucket everywhere — and distinct seeds
+    of one config must share a key (they coalesce into one batched
+    call on the replica)."""
+    from fastconsensus_tpu.serve import bucketer
+    from fastconsensus_tpu.serve.router import route_key
+
+    for n_nodes, n_edges in ((34, 78), (64, 96), (100, 500), (65, 193)):
+        payload = {"edges": [[0, 1]] * n_edges, "n_nodes": n_nodes,
+                   "algorithm": "louvain", "n_p": 4, "seed": 1}
+        b = bucketer.bucket_for(n_nodes, n_edges)
+        assert route_key(payload).startswith(b.key() + "|")
+        assert route_key(payload) == route_key(dict(payload, seed=99))
+    # config-minus-seed fields keep traffic apart
+    base = {"edges": [[0, 1]] * 64, "n_nodes": 34, "n_p": 4}
+    assert route_key(base) != route_key(dict(base, n_p=8))
+    assert route_key(base) != route_key(dict(base, tau=0.3))
+    # edgelist payloads count raw lines, comments/blanks excluded
+    el = "# header\n0 1\n1 2\n\n2 3\n"
+    assert route_key({"edgelist": el, "n_nodes": 34}) == \
+        route_key({"edges": [[0, 1]] * 3, "n_nodes": 34})
+
+
+# -- jax-free tier + typed stats --------------------------------------
+
+
+def test_fleet_tier_is_jax_free_and_stats_parse():
+    """The whole router tier (router.py, fleet.py) plus the typed
+    FleetStats/ReplicaState client views must import and work with jax
+    POISONED — the front-end ships to boxes with no accelerator
+    stack."""
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "from fastconsensus_tpu.serve.router import (\n"
+        "    FleetRouter, HashRing, route_key)\n"
+        "from fastconsensus_tpu.serve.fleet import FleetManager\n"
+        "from fastconsensus_tpu.serve.client import (\n"
+        "    FleetStats, ReplicaState)\n"
+        "fs = FleetStats.from_payload({\n"
+        "    'replicas': [\n"
+        "        {'name': 'a', 'url': 'http://h:1', 'state': 'up',\n"
+        "         'queue_depth': 3, 'queue_max_depth': 64,\n"
+        "         'watchdog_trips': 0},\n"
+        "        {'name': 'b', 'url': 'http://h:2',\n"
+        "         'state': 'cordoned', 'cordon_reason': 'trip',\n"
+        "         'retry_after_hint_s': 1.5}],\n"
+        "    'ring': {'members': ['a', 'b'], 'vnodes': 128},\n"
+        "    'assignments': {'n64_e96|': 'a'},\n"
+        "    'jobs_tracked': 7, 'jobs_in_flight': 2,\n"
+        "    'content_hash_index': 5,\n"
+        "    'counters': {'serve.fleet.cordons': 1}})\n"
+        "assert [r.name for r in fs.up] == ['a']\n"
+        "assert fs.replicas[1].cordoned\n"
+        "assert fs.replicas[1].retry_after_hint_s == 1.5\n"
+        "assert fs.ring_members == ('a', 'b') and fs.vnodes == 128\n"
+        "assert fs.counters['serve.fleet.cordons'] == 1\n"
+        "assert fs.assignments == {'n64_e96|': 'a'}\n"
+        "print('fleet jax-free ok')\n")
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    res = subprocess.run([sys.executable, "-c", code], cwd=REPO_ROOT,
+                         env=env, capture_output=True, text=True,
+                         timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "fleet jax-free ok" in res.stdout
+
+
+# -- ServeClient.retry -------------------------------------------------
+
+
+def test_client_retry_honors_typed_hint_with_backoff_and_jitter():
+    """retry() must sleep the server's TYPED retry_after_s scaled by
+    backoff**attempt plus bounded jitter — never a blind fixed
+    backoff — and re-raise the final Backpressure."""
+    from fastconsensus_tpu.serve.client import Backpressure, ServeClient
+
+    client = ServeClient("http://127.0.0.1:1")   # never dialed
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise Backpressure(429, {"backpressure": True},
+                               retry_after_s=2.0)
+        return "served"
+
+    out = client.retry(flaky, attempts=4, backoff=1.5, jitter_frac=0.1,
+                       sleep=sleeps.append, rng=random.Random(7))
+    assert out == "served" and calls["n"] == 3
+    assert len(sleeps) == 2
+    for attempt, s in enumerate(sleeps):
+        base = 2.0 * (1.5 ** attempt)
+        assert base <= s <= base * 1.1, \
+            f"sleep {s} outside [{base}, {base * 1.1}]"
+
+    def always_shedding():
+        raise Backpressure(429, {"backpressure": True, "shed": True},
+                           retry_after_s=0.5)
+
+    sleeps.clear()
+    with pytest.raises(Backpressure):
+        client.retry(always_shedding, attempts=3,
+                     sleep=sleeps.append, rng=random.Random(7))
+    assert len(sleeps) == 2          # final attempt re-raises, no sleep
+    with pytest.raises(ValueError):
+        client.retry(flaky, attempts=0)
+    with pytest.raises(ValueError):
+        client.retry(flaky, backoff=0.5)
+
+
+# -- ResultCache persistence pins (the death-inheritance substrate) ---
+
+
+def _cacheable(seed):
+    import numpy as np
+
+    return {"partitions": [np.full(8, seed, dtype=np.int32)],
+            "n_nodes": 8, "seed": seed}
+
+
+def test_cache_spill_if_dirty_skips_clean_and_concurrent(tmp_path):
+    """The fcfleet periodic-spill contract: dirty -> spill count,
+    clean -> 0 without touching disk, concurrent spill holding the
+    lock -> -1 plus a counter, and a reload marks the cache dirty (the
+    inheritor must re-spill what it inherited or a second death loses
+    it)."""
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.cache import ResultCache
+
+    path = str(tmp_path / "spill.npz")
+    c = ResultCache(max_entries=8, ttl_seconds=600.0)
+    c.put("h1", _cacheable(1))
+    assert c.spill_if_dirty(path) == 1
+    mtime = os.path.getmtime(path)
+    assert c.spill_if_dirty(path) == 0       # clean: no rewrite
+    assert os.path.getmtime(path) == mtime
+    c.put("h2", _cacheable(2))
+    base = obs_counters.get_registry().counters()
+    assert c._spill_lock.acquire(blocking=False)
+    try:
+        assert c.spill_if_dirty(path) == -1  # concurrent writer holds it
+    finally:
+        c._spill_lock.release()
+    since = obs_counters.get_registry().counters_since(base)
+    assert since.get("serve.cache.persist_concurrent_skip", 0) == 1
+    assert c.spill_if_dirty(path) == 2       # still dirty, spills now
+
+    heir = ResultCache(max_entries=8, ttl_seconds=600.0)
+    assert heir.load(path) == 2
+    assert heir.spill_if_dirty(str(tmp_path / "re.npz")) == 2
+
+
+# -- router forwarding over a live replica ----------------------------
+
+
+@pytest.fixture
+def replica():
+    """One real loopback replica with its worker NOT started, so queue
+    contents are observable and deterministic."""
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig,
+                                                make_http_server)
+    from fastconsensus_tpu.serve.shaping import ShapingConfig
+
+    svc = ConsensusService(ServeConfig(queue_depth=16, pin_sizing=False,
+                                       shaping=ShapingConfig(shed=False)))
+    httpd = make_http_server(svc, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield svc, f"http://127.0.0.1:{port}"
+    finally:
+        httpd.shutdown()
+        svc.queue.close()
+
+
+def test_router_forwarding_preserves_edf_order(replica):
+    """Priority submitted THROUGH the router must come out of the
+    replica's admission queue EDF-major exactly as if submitted
+    directly — forwarding must not flatten the priority band."""
+    import json
+
+    from fastconsensus_tpu.serve.jobs import (PRIORITY_BATCH,
+                                              PRIORITY_INTERACTIVE,
+                                              PRIORITY_NORMAL)
+    from fastconsensus_tpu.serve.router import FleetRouter
+
+    svc, url = replica
+    router = FleetRouter({"r0": url}, poll_s=60.0)
+    router.poll_once()
+    edges = [[i, (i + 1) % 12] for i in range(12)]
+    submitted = []
+    for seed, prio in enumerate((PRIORITY_BATCH, PRIORITY_NORMAL,
+                                 PRIORITY_INTERACTIVE)):
+        body = json.dumps({"edges": edges, "n_nodes": 12,
+                           "algorithm": "lpm", "n_p": 2,
+                           "max_rounds": 2, "seed": seed,
+                           "priority": prio}).encode("utf-8")
+        status, out, _ = router.submit(body)
+        assert status == 202, out
+        assert out["fleet_replica"] == "r0"
+        submitted.append((prio, out["job_id"]))
+    pops = [svc.queue.pop(timeout=5.0) for _ in submitted]
+    # PRIORITY_INTERACTIVE=0 < NORMAL=1 < BATCH=2: the heap pops the
+    # lowest priority number first
+    assert [j.spec.priority for j in pops] == sorted(
+        p for p, _ in submitted), \
+        "queue must drain interactive -> normal -> batch"
+    stats = router.fleet_stats()
+    assert stats["jobs_tracked"] == 3
+    assert set(stats["assignments"].values()) == {"r0"}
+
+
+def test_router_cordon_routes_around_dead_replica(replica):
+    """A cordoned replica must receive NOTHING (exclusion at lookup),
+    and uncordon must restore it without a restart."""
+    import json
+
+    from fastconsensus_tpu.serve.router import FleetRouter
+
+    svc, url = replica
+    # "ghost" listens nowhere: if routing ever picks it the forward
+    # errors out and the counters show it
+    router = FleetRouter({"live": url, "ghost": "http://127.0.0.1:9"},
+                         poll_s=60.0)
+    router.cordon("ghost", "test: known dead")
+    for seed in range(6):
+        body = json.dumps({"edges": [[0, 1], [1, 2]], "n_nodes": 8,
+                           "algorithm": "lpm", "n_p": 2,
+                           "max_rounds": 2, "seed": seed,
+                           "tau": seed / 10.0}).encode("utf-8")
+        status, out, _ = router.submit(body)
+        assert status == 202 and out["fleet_replica"] == "live"
+    stats = router.fleet_stats()
+    assert set(stats["assignments"].values()) == {"live"}
+    states = {r["name"]: r["state"] for r in stats["replicas"]}
+    assert states == {"live": "up", "ghost": "cordoned"}
+    router.uncordon("ghost")
+    states = {r["name"]: r["state"]
+              for r in router.fleet_stats()["replicas"]}
+    assert states["ghost"] == "up"
