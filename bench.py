@@ -1019,10 +1019,12 @@ def bench_serve_fleet() -> int:
     import shutil
     import tempfile
     import threading
+    import urllib.request
 
     import numpy as np
 
     from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.obs import latency as obs_latency
     from fastconsensus_tpu.serve import bucketer
     from fastconsensus_tpu.serve.client import (Backpressure, JobFailed,
                                                 ServeClient)
@@ -1203,8 +1205,29 @@ def bench_serve_fleet() -> int:
             "records": records,
         }
 
+    def merged_p95_ms(hists, name: str):
+        """p95 (ms) over the UNION of every ``name`` histogram's
+        samples, tags ignored — exact on the shared fixed-bucket grid
+        (obs/latency.merge_snapshots), so a fleet-wide e2e p95 needs
+        no raw samples."""
+        rows = [h for h in hists if h.get("name") == name]
+        if not rows:
+            return None
+        p95 = obs_latency.merge_snapshots(rows).get("p95_s")
+        return None if p95 is None else round(p95 * 1000.0, 3)
+
+    def hist_counts(hists) -> dict:
+        out: dict = {}
+        for h in hists:
+            key = (str(h.get("name")),
+                   tuple(sorted((str(k), str(v)) for k, v in
+                                (h.get("tags") or {}).items())))
+            out[key] = out.get(key, 0) + int(h.get("count", 0))
+        return out
+
     points: list = []
     drill: dict = {}
+    fleet_latency: dict = {}
     total_warm = 0
     drain_codes: dict = {}
     try:
@@ -1259,6 +1282,54 @@ def bench_serve_fleet() -> int:
                       f"{warm} executable(s) — prewarm/shipping is not "
                       f"holding", file=sys.stderr)
             points.append(point)
+
+        # ---- fctrace: /fleetz scrape over the healthy fleet ---------
+        # Scraped BEFORE the chaos drill: the merge-exactness check
+        # wants quiescent counts, and a half-dead fleet would trip the
+        # replicas_down gate for the wrong reason (the drill's own
+        # health rules live in check_serve_fleet).
+        print("serve_fleet: scraping /fleetz (fctrace aggregate)...",
+              file=sys.stderr)
+        with urllib.request.urlopen(url + "/fleetz",
+                                    timeout=30.0) as resp:
+            fz = json.loads(resp.read())
+        rep_hists = {}
+        for nm, rep in fleet.replicas.items():
+            lat = ServeClient(rep.base_url, timeout=10.0) \
+                .metricsz().get("latency") or {}
+            rep_hists[nm] = lat.get("histograms") or []
+        # bit-exact merge contract: the fleet aggregate's per-(name,
+        # tags) counts must EQUAL the sum of the per-replica scrapes
+        merge_exact = (hist_counts(
+            h for hs in rep_hists.values() for h in hs) == hist_counts(
+            (fz.get("latency") or {}).get("histograms") or ()))
+        router_hists = ((fz.get("router") or {}).get("latency")
+                        or {}).get("histograms") or ()
+        worst_e2e = [v for v in
+                     (merged_p95_ms(hs, "serve.e2e")
+                      for hs in rep_hists.values()) if v is not None]
+        fleet_latency = {
+            "replicas_scraped": sum(
+                1 for r in (fz.get("replicas") or {}).values()
+                if r.get("ok")),
+            "replicas_down": sorted(
+                nm for nm, r in (fz.get("replicas") or {}).items()
+                if not r.get("ok")),
+            "merge_exact": merge_exact,
+            "router_phase_p95_ms": {
+                ph: merged_p95_ms(router_hists, f"router.phase.{ph}")
+                for ph in ("admit", "ring_lookup", "proxy", "replay")},
+            "proxy_overhead_p95_ms": {
+                nm: (None if (v or {}).get("p95_s") is None
+                     else round(float(v["p95_s"]) * 1000.0, 3))
+                for nm, v in ((fz.get("router") or {})
+                              .get("proxy_overhead") or {}).items()},
+            "fleet_e2e_p95_ms": merged_p95_ms(
+                (fz.get("latency") or {}).get("histograms") or (),
+                "serve.e2e"),
+            "worst_replica_e2e_p95_ms": max(worst_e2e)
+            if worst_e2e else None,
+        }
 
         # ---- chaos drill on the full fleet --------------------------
         stats = fleet.router.fleet_stats()
@@ -1409,6 +1480,12 @@ def bench_serve_fleet() -> int:
                 "drill": drill,
                 "drain_exit_codes": drain_codes,
             },
+            # fctrace /fleetz scrape (pre-drill, fleet healthy): the
+            # exact-merge verdict, router-phase p95s, per-replica
+            # proxy-overhead attribution, fleet-merged e2e p95 vs the
+            # worst single replica — gated by
+            # history.check_fleet_latency
+            "fleet_latency": fleet_latency,
         },
     }
     print(json.dumps(out))
@@ -1433,6 +1510,8 @@ def bench_serve_fleet() -> int:
               "serve.fleet.rehomed_buckets", 0) >= 1
           and len(drill.get("bundles", ())) >= 1
           and drill.get("resubmit_after_death", {}).get("cached") is True
+          and fleet_latency.get("merge_exact") is True
+          and not fleet_latency.get("replicas_down")
           and all(c == 0 for c in drain_codes.values()))
     if not ok:
         print("serve_fleet: GATE FAILED — see the artifact's points/"

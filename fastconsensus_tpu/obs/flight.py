@@ -48,7 +48,17 @@ Event vocabulary (the serving stack's instrumentation points; the
 ``watchdog_trip``  the hang watchdog declared a worker suspect
 ``bundle``         a post-mortem bundle was written
 ``span_open``/``span_close``  tracer spans, mirrored when tracing is on
+``proxy``          router proxied a status/result poll to a replica
+``rehome_replay``  router replayed an in-flight job onto a new replica
+``fleet_bundle``   FleetManager collected a replica's bundles (fctrace)
 =================  ====================================================
+
+The router tier (serve/router.py) records into the same vocabulary:
+``route`` doubles as the router's placement decision (ring lookup →
+replica), and ``proxy``/``rehome_replay``/``fleet_bundle`` are the
+router/fleet-side kinds — every one carries the ``trace`` id minted at
+submit, which is how ``fleettrace render`` stitches router and replica
+rings into one timeline.
 
 Everything here is stdlib-only and jax-free: the post-mortem reader
 (``python -m fastconsensus_tpu.obs.postmortem``) renders snapshots on a
@@ -77,6 +87,7 @@ EVENT_KINDS = (
     "admit", "reject_429", "shed", "hold", "pop", "route", "dequeue",
     "device", "device_done", "finish", "fail", "cache_hit", "cordon",
     "requeue", "watchdog_trip", "bundle", "span_open", "span_close",
+    "proxy", "rehome_replay", "fleet_bundle",
 )
 
 
@@ -167,7 +178,15 @@ class FlightRecorder:
 
     def snapshot(self) -> Dict[str, Any]:
         """All rings (each copied atomically under its own lock): the
-        bundle's ``flight.json`` payload."""
+        bundle's ``flight.json`` payload.
+
+        The ``time_unix``/``time_mono`` pair is the monotonic↔wall
+        clock anchor (same convention as the bundle MANIFEST): event
+        ``ts`` values are ``time.monotonic()`` stamps, so a reader maps
+        them onto the wall clock via ``ts + (time_unix - time_mono)``.
+        That is what lets ``fleettrace render`` align snapshots taken
+        on DIFFERENT processes (each with its own monotonic epoch) onto
+        one shared fleet timeline."""
         with self._lock:
             rings = list(self._rings)
         ring_snaps = [r.snapshot() for r in rings]
@@ -176,6 +195,8 @@ class FlightRecorder:
             "max_rings": self.max_rings,
             "n_events": sum(len(r["events"]) for r in ring_snaps),
             "dropped": sum(r["dropped"] for r in ring_snaps),
+            "time_unix": round(time.time(), 3),
+            "time_mono": round(time.monotonic(), 6),
             "rings": ring_snaps,
         }
 

@@ -46,7 +46,10 @@ keep the block verbatim (``serve_fleet`` in the normalized record),
 the first artifact, scaling-efficiency trajectory once a same-size
 predecessor exists.  Their headline value is a higher-is-better
 scaling ratio, so :func:`check_history` skips its value rules for
-them too.
+them too.  Since PR 18 those artifacts also carry a
+``telemetry.fleet_latency`` block (the router's ``/fleetz`` scrape —
+router-phase p95s, per-replica proxy overhead, the fleet-merged e2e
+p95, the exact-merge verdict); :func:`check_fleet_latency` gates it.
 
 The fcqual quality block (``telemetry.quality`` — obs/quality.py's
 :func:`~fastconsensus_tpu.obs.quality.summarize_history` output, stamped
@@ -109,6 +112,19 @@ DEFAULT_R429_GROWTH = 0.20        # absolute 429-rate growth at ref RPS
 # history.
 DEFAULT_FLEET_SCALING_DROP = 0.15   # fractional efficiency drop vs median
 DEFAULT_FLEET_ATTAIN_MIN = 0.99     # absolute SLO attainment floor/point
+
+# fctrace (telemetry.fleet_latency) gate thresholds.  The absolute
+# rules arm from the first committed artifact: an unscrapable replica
+# during the /fleetz scrape, an inexact histogram merge (fleet counts
+# != sum of per-replica counts), or a merged fleet p95 that EXCEEDS
+# the worst single replica's p95 (a mixture quantile on a shared
+# bucket grid is bounded by its components — violating that means the
+# merge is wrong, not the fleet slow) each block.  The trajectory
+# bounds are loose, like the serve_load ones: CPU-CI proxy hops are
+# scheduler-noisy, and the gate hunts the 2-10x regressions a
+# busy-poll or serialization bug produces.
+DEFAULT_FLEET_E2E_GROWTH = 1.0      # fleet-merged e2e p95 may double
+DEFAULT_PROXY_OVERHEAD_GROWTH = 1.5 # worst proxy-overhead p95 growth
 
 # fcqual (quality-block) gate thresholds.  Same calibration philosophy:
 # loose enough that detector stochasticity (seeded, but the LFR graphs
@@ -203,6 +219,12 @@ def _normalize(rec: dict, source: str, seq: Optional[int]) -> dict:
         # hang watchdog is a serving regression even when the latency
         # curve still passes
         "flight": tel.get("flight") or None,
+        # fctrace fleet-latency block (bench.py serve_fleet, scraped
+        # from the router's /fleetz): router-phase p95s, per-replica
+        # proxy overhead, fleet-merged e2e p95 vs the worst single
+        # replica, and the exact-merge verdict, kept verbatim for
+        # check_fleet_latency()
+        "fleet_latency": tel.get("fleet_latency") or None,
         # fcqual quality block (obs/quality.py summarize_history), kept
         # verbatim for quality_table() and check_quality(); None on
         # pre-fcqual artifacts
@@ -517,7 +539,11 @@ def serve_fleet_table(groups: Dict[str, List[dict]],
     RPS, failure/shed counts, percentiles, SLO attainment, and warm
     compiles; then a one-row drill summary (victim, drain exit,
     successor, re-homed groups, bundles, the inherited-cache
-    resubmit).  Empty string when no record has the block."""
+    resubmit); then, when the record carries the r18
+    ``fleet_latency`` block, the fctrace summary — router phase p95s,
+    the exact-merged fleet e2e p95 against the worst single replica,
+    and the per-replica proxy-overhead attribution.  Empty string
+    when no record has the block."""
     header = ["replicas", "offered", "achieved", "jobs", "failed",
               "429s", "p50_ms", "p95_ms", "attain", "compiles"]
     lines: List[str] = []
@@ -562,6 +588,29 @@ def serve_fleet_table(groups: Dict[str, List[dict]],
                       "serve.fleet.rehomed_buckets"), 0),
                   _fmt(len(drill.get("bundles") or ()), 0),
                   _fmt(resub.get("cached"))]], markdown)
+        fl = newest.get("fleet_latency") or {}
+        if fl:
+            ph = fl.get("router_phase_p95_ms") or {}
+            down = ",".join(fl.get("replicas_down") or ()) or "-"
+            lines += _render_rows(
+                f"{config} fctrace fleet latency [{newest['source']}; "
+                f"merge_exact={_fmt(fl.get('merge_exact'))}; "
+                f"down={down}]",
+                ["admit_p95", "ring_p95", "proxy_p95", "replay_p95",
+                 "fleet_e2e_p95", "worst_e2e_p95"],
+                [[_fmt(ph.get("admit")), _fmt(ph.get("ring_lookup")),
+                  _fmt(ph.get("proxy")), _fmt(ph.get("replay")),
+                  _fmt(fl.get("fleet_e2e_p95_ms"), 1),
+                  _fmt(fl.get("worst_replica_e2e_p95_ms"), 1)]],
+                markdown)
+            overhead = fl.get("proxy_overhead_p95_ms") or {}
+            if overhead:
+                lines += _render_rows(
+                    f"{config} router proxy overhead per replica "
+                    f"[{newest['source']}]",
+                    ["replica", "proxy_p95_ms"],
+                    [[name, _fmt(overhead[name])]
+                     for name in sorted(overhead)], markdown)
     return "\n".join(lines).rstrip()
 
 
@@ -722,6 +771,100 @@ def check_flight(groups: Dict[str, List[dict]]) -> List[str]:
                     f"hang watchdog tripped {trips} time(s) during a "
                     f"clean sequenced load run — a serving stall or a "
                     f"threshold regression (telemetry.flight)")
+    return problems
+
+
+def _worst_proxy_p95(rec: dict) -> Optional[float]:
+    """The slowest replica's proxy-overhead p95 (ms) in one record's
+    fleet_latency block — the per-replica attribution folded to the
+    single worst number a trajectory can run on."""
+    fl = rec.get("fleet_latency") or {}
+    vals = [float(v) for v in (fl.get("proxy_overhead_p95_ms")
+                               or {}).values() if v is not None]
+    return max(vals) if vals else None
+
+
+def check_fleet_latency(groups: Dict[str, List[dict]],
+                        e2e_growth: float = DEFAULT_FLEET_E2E_GROWTH,
+                        proxy_growth: float =
+                        DEFAULT_PROXY_OVERHEAD_GROWTH) -> List[str]:
+    """fctrace findings over records carrying a ``fleet_latency``
+    block (bench.py serve_fleet's /fleetz scrape); [] means the gate
+    passes.  Judged on the newest sequence only, two kinds of rule:
+
+    * **Absolute**, armed from the first committed artifact: a replica
+      the /fleetz scrape could not reach, an inexact merge (fleet
+      histogram counts != sum of per-replica counts — the bit-exact
+      merge contract broke), or a fleet-merged e2e p95 above the worst
+      single replica's p95 (impossible for a correct mixture quantile
+      on the shared bucket grid; small-count bucket rounding gets a
+      5% tolerance).
+    * **Trajectory**: fleet-merged e2e p95 and worst-replica proxy
+      overhead p95 vs the median of sequenced predecessors — growth
+      beyond ``e2e_growth`` / ``proxy_growth`` (fractional) is a
+      finding.  Pre-fctrace artifacts pass vacuously."""
+    problems: List[str] = []
+    for config, recs in groups.items():
+        seqd = [r for r in recs if r["seq"] is not None
+                and r.get("fleet_latency")]
+        if not seqd:
+            continue
+        latest_seq = max(r["seq"] for r in seqd)
+        for r in seqd:
+            if r["seq"] != latest_seq:
+                continue
+            tag = f"{config} [{r['source']} seq {r['seq']}]"
+            fl = r["fleet_latency"]
+            down = fl.get("replicas_down") or ()
+            if down:
+                problems.append(
+                    f"{tag}: /fleetz could not scrape "
+                    f"{', '.join(str(d) for d in down)} — a fleet "
+                    f"aggregate that omits a replica reads healthy "
+                    f"exactly when it is not")
+            if fl.get("merge_exact") is False:
+                problems.append(
+                    f"{tag}: the /fleetz histogram merge is inexact — "
+                    f"fleet counts != sum of per-replica counts, the "
+                    f"bit-exact merge contract broke")
+            fleet_p95 = fl.get("fleet_e2e_p95_ms")
+            worst_p95 = fl.get("worst_replica_e2e_p95_ms")
+            if fleet_p95 is not None and worst_p95 is not None \
+                    and float(fleet_p95) > 1.05 * float(worst_p95):
+                problems.append(
+                    f"{tag}: fleet-merged e2e p95 {fleet_p95:.1f}ms "
+                    f"exceeds the worst replica's {worst_p95:.1f}ms — "
+                    f"a mixture quantile cannot, so the merge (or the "
+                    f"scrape) is wrong")
+            # trajectory vs the median of sequenced predecessors
+            prior = [p for p in seqd if p["seq"] < latest_seq]
+            if fleet_p95 is not None:
+                base = [float(p["fleet_latency"]["fleet_e2e_p95_ms"])
+                        for p in prior
+                        if p["fleet_latency"].get("fleet_e2e_p95_ms")
+                        is not None]
+                if base:
+                    ceil = (1.0 + e2e_growth) * _median(base)
+                    if float(fleet_p95) > ceil:
+                        problems.append(
+                            f"{tag}: fleet-merged e2e p95 "
+                            f"{float(fleet_p95):.1f}ms grew past "
+                            f"{ceil:.1f}ms ({e2e_growth:.0%} over the "
+                            f"prior median) — the fleet's tail "
+                            f"regressed")
+            worst_proxy = _worst_proxy_p95(r)
+            if worst_proxy is not None:
+                base = [w for w in (_worst_proxy_p95(p) for p in prior)
+                        if w is not None]
+                if base:
+                    ceil = (1.0 + proxy_growth) * _median(base)
+                    if worst_proxy > ceil:
+                        problems.append(
+                            f"{tag}: worst-replica proxy overhead p95 "
+                            f"{worst_proxy:.2f}ms grew past "
+                            f"{ceil:.2f}ms ({proxy_growth:.0%} over "
+                            f"the prior median) — the router hop got "
+                            f"expensive")
     return problems
 
 

@@ -240,6 +240,34 @@ def merge_snapshots(snaps: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     return _snapshot_from(buckets, count, total, vmin, vmax, exemplars)
 
 
+def merge_registry_snapshots(snaps: Iterable[Dict[str, Any]]
+                             ) -> Dict[str, Any]:
+    """Exact merge of whole :meth:`LatencyRegistry.snapshot` payloads —
+    the cross-PROCESS use of :func:`merge_snapshots` (fctrace): the
+    router's ``/fleetz`` feeds every replica's ``/metricsz`` latency
+    block through this, and because the log2 buckets are fixed the
+    merged quantiles are bit-identical to one registry having recorded
+    every replica's samples.
+
+    Histograms are matched by ``(name, sorted tags)`` — the registry's
+    own identity — and each merged entry reports how many source
+    registries contributed (``sources``).  The rate-tracker views
+    (``arrivals``/``dispatches``) are deliberately NOT merged: their
+    windows are monotonic stamps on per-process clocks, which have no
+    shared epoch to merge on.
+    """
+    groups: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                 List[Dict[str, Any]]] = {}
+    for snap in snaps:
+        for h in (snap or {}).get("histograms") or ():
+            key = (str(h.get("name")), _tag_key(h.get("tags") or {}))
+            groups.setdefault(key, []).append(h)
+    return {"histograms": [
+        {"name": name, "tags": dict(tags), "sources": len(hs),
+         **merge_snapshots(hs)}
+        for (name, tags), hs in sorted(groups.items())]}
+
+
 def diff_snapshots(new: Dict[str, Any],
                    old: Dict[str, Any]) -> Dict[str, Any]:
     """Merge's inverse: the histogram of samples recorded *between* two

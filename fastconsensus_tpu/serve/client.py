@@ -355,6 +355,102 @@ class FleetStats:
                              (f.get("counters") or {}).items()})
 
 
+@dataclasses.dataclass(frozen=True)
+class FleetLatency:
+    """The fcfleet router's ``GET /fleetz`` aggregate
+    (obs/fleettrace.py fctrace), typed: the exact-merged fleet-wide
+    fclat histograms (``histograms`` — merged bucket-by-bucket with
+    the PR 9 fixed-bucket semantics, so quantiles are bit-identical
+    to a single registry having observed every sample), per-class SLO
+    attainment summed across replicas, summed fcobs counters, the
+    router's own ``router.phase.*`` histograms, and the per-replica
+    proxy-overhead attribution.  ``replicas_ok`` records which
+    replicas answered the scrape — an unreachable replica appears as
+    False, never silently vanishes from the aggregate."""
+
+    scope: str
+    replicas_ok: Dict[str, bool]
+    histograms: Tuple[PhaseLatency, ...]
+    slo: Tuple[SloStats, ...]
+    counters: Dict[str, int]
+    router_histograms: Tuple[PhaseLatency, ...]
+    proxy_overhead: Dict[str, Dict[str, float]]
+
+    @property
+    def replicas_down(self) -> Tuple[str, ...]:
+        return tuple(sorted(n for n, ok in self.replicas_ok.items()
+                            if not ok))
+
+    def histogram(self, name: str, **tags: str) -> Optional[PhaseLatency]:
+        """The merged fleet histogram for one (name, tags) pair."""
+        want = {str(k): str(v) for k, v in tags.items()}
+        for h in self.histograms:
+            if h.name == name and h.tags == want:
+                return h
+        return None
+
+    @classmethod
+    def from_payload(cls, p: Dict[str, Any]) -> "FleetLatency":
+        lat = p.get("latency") or {}
+        router = p.get("router") or {}
+        rlat = router.get("latency") or {}
+        return cls(
+            scope=str(p.get("scope", "fleet")),
+            replicas_ok={str(k): bool((v or {}).get("ok", False))
+                         for k, v in (p.get("replicas") or {}).items()},
+            histograms=tuple(PhaseLatency.from_payload(h)
+                             for h in lat.get("histograms") or ()),
+            slo=tuple(SloStats.from_payload(name, s)
+                      for name, s in sorted((p.get("slo") or {}).items())),
+            counters={str(k): int(v) for k, v in
+                      (p.get("counters") or {}).items()},
+            router_histograms=tuple(PhaseLatency.from_payload(h)
+                                    for h in rlat.get("histograms") or ()),
+            proxy_overhead={
+                str(k): {str(a): float(b) for a, b in (v or {}).items()
+                         if b is not None}
+                for k, v in (router.get("proxy_overhead") or {}).items()})
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceTimeline:
+    """A fleettrace merged incident timeline (the ``fctrace-timeline``
+    JSON emitted by ``python -m fastconsensus_tpu.obs.fleettrace render
+    --json``), typed: the clock-aligned, replica-tagged event stream
+    merged from every collected bundle — each event carries its source
+    ``replica`` and a wall-clock ``t_wall`` (events are sorted on it),
+    plus its original flight fields (ts/kind/thread/job/trace/aux).
+    ``trace`` echoes the trace-id filter the render ran with (None for
+    an unfiltered fleet timeline)."""
+
+    trace: Optional[str]
+    replicas: Tuple[str, ...]
+    n_events: int
+    events_per_replica: Dict[str, int]
+    skipped_bundles: Tuple[str, ...]
+    events: Tuple[Dict[str, Any], ...]
+    schema: int = 1
+    tool: str = "fctrace-timeline"
+
+    def for_replica(self, name: str) -> Tuple[Dict[str, Any], ...]:
+        return tuple(e for e in self.events if e.get("replica") == name)
+
+    @classmethod
+    def from_payload(cls, p: Dict[str, Any]) -> "TraceTimeline":
+        t = p.get("trace")
+        return cls(schema=int(p.get("schema", 1)),
+                   tool=str(p.get("tool", "fctrace-timeline")),
+                   trace=None if t is None else str(t),
+                   replicas=tuple(str(r) for r in p.get("replicas") or ()),
+                   n_events=int(p.get("n_events", 0)),
+                   events_per_replica={
+                       str(k): int(v) for k, v in
+                       (p.get("events_per_replica") or {}).items()},
+                   skipped_bundles=tuple(
+                       str(b) for b in p.get("skipped_bundles") or ()),
+                   events=tuple(dict(e) for e in p.get("events") or ()))
+
+
 # What Backpressure.retry_after_s reports when the server sent no (or a
 # malformed) Retry-After — the pre-fcshape constant, kept as the
 # honest "we know nothing" floor.
@@ -498,12 +594,21 @@ class ServeClient:
         compiles, busy-fraction, cordon state), keyed by device id."""
         return self.metricsz().get("devices", {})
 
+    def scope(self) -> str:
+        """What ``base_url`` points at, as ``/metricsz`` self-describes
+        it: ``"router"`` or ``"replica"``.  Pre-fctrace servers sent no
+        scope field; they can only have been replicas."""
+        return str(self.metricsz().get("scope", "replica"))
+
     def latency(self) -> Dict[str, Any]:
         """The fclat request-latency view from ``/metricsz``, typed:
         ``histograms`` ([:class:`PhaseLatency`] — per-phase and
         end-to-end distributions tagged by bucket/rung/priority/
         device), ``slo`` ([:class:`SloStats`] per class), and the raw
-        per-bucket ``arrivals`` / ``dispatches`` rate maps."""
+        per-bucket ``arrivals`` / ``dispatches`` rate maps.  Works
+        against both scopes: a router's block holds its
+        ``router.phase.*`` histograms and (having no SLO accounting of
+        its own) empty slo/arrivals/dispatches maps."""
         block = self.metricsz().get("latency", {})
         return {
             "histograms": [PhaseLatency.from_payload(h)
@@ -568,6 +673,20 @@ class ServeClient:
         caller can probe what it is talking to."""
         f = self.healthz().get("fleet")
         return None if f is None else FleetStats.from_payload(f)
+
+    def fleetz(self) -> FleetLatency:
+        """The router's fleet-wide latency aggregate (``GET /fleetz``),
+        typed — exact-merged histograms, summed SLO/counters, router
+        phase histograms, per-replica proxy overhead.  Raises
+        :class:`ServeError` (404) against a plain replica."""
+        return FleetLatency.from_payload(self._request("/fleetz"))
+
+    def flight(self) -> Dict[str, Any]:
+        """The server's raw fcflight ring snapshot
+        (``GET /debugz/flight``) with its ``scope`` tag — the
+        per-process half of a fleettrace timeline, one HTTP GET away
+        (both tiers serve it)."""
+        return self._request("/debugz/flight")
 
     def retry(self, call, attempts: int = 6, backoff: float = 1.5,
               jitter_frac: float = 0.1, max_sleep_s: float = 30.0,

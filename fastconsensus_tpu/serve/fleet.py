@@ -30,7 +30,11 @@ the fleet's lifecycle stories:
   the new replica takes its first request warm;
 * **bundle collection** — :meth:`snapshot_bundles` SIGQUITs every live
   replica (the fcflight "dump and keep serving" signal) and gathers
-  the per-replica post-mortem bundle paths.
+  the per-replica post-mortem bundle paths; :meth:`collect_bundles`
+  goes one step further (fctrace) and copies every replica's bundles —
+  dead ones included — into ONE ``<replica>__<bundle>`` directory that
+  ``python -m fastconsensus_tpu.obs.fleettrace render`` merges into a
+  clock-aligned fleet incident timeline.
 
 Like the router, this module never imports jax: the replicas pay the
 engine cost in their own processes, the manager is pure stdlib.
@@ -397,7 +401,10 @@ class FleetManager:
     def snapshot_bundles(self, timeout_s: float = 30.0) -> List[str]:
         """SIGQUIT every live replica (fcflight: dump a post-mortem
         bundle, keep serving) and collect the bundle paths that
-        appear."""
+        appear.  A bundle counts only once its MANIFEST.json exists —
+        the dump writes the manifest LAST, so a bare fresh directory
+        is still mid-write and a collector that took it would skip it
+        as a partial."""
         live = [r for r in self.replicas.values() if r.alive()]
         before = {r.name: set(r.bundles()) for r in live}
         for r in live:
@@ -409,7 +416,9 @@ class FleetManager:
             for r in live:
                 if r.name not in pending:
                     continue
-                fresh = set(r.bundles()) - before[r.name]
+                fresh = {
+                    b for b in set(r.bundles()) - before[r.name]
+                    if os.path.isfile(os.path.join(b, "MANIFEST.json"))}
                 if fresh:
                     collected += sorted(fresh)
                     pending.discard(r.name)
@@ -421,6 +430,58 @@ class FleetManager:
         out: List[str] = []
         for r in self.replicas.values():
             out += r.bundles()
+        return out
+
+    def collect_bundles(self, dest_dir: Optional[str] = None,
+                        snapshot: bool = True,
+                        timeout_s: float = 30.0) -> Dict[str, List[str]]:
+        """Gather EVERY replica's bundles into one directory — the
+        fctrace incident-merge input.  ``snapshot=True`` first SIGQUITs
+        the live replicas (:meth:`snapshot_bundles`) so the collection
+        includes a fresh dump of each survivor; dead replicas
+        contribute whatever their flight dirs already hold (the
+        watchdog/death bundles written before they went).
+
+        Each bundle lands as ``<replica>__<bundle_name>`` (the
+        :data:`~fastconsensus_tpu.obs.fleettrace.REPLICA_SEP` layout
+        ``fleettrace render`` splits its replica tracks on); returns
+        replica name -> collected paths.  Collection is copy-based so
+        the replicas' own flight dirs stay intact for any later reader.
+        """
+        import shutil
+
+        from fastconsensus_tpu.obs import flight as obs_flight
+        from fastconsensus_tpu.obs.fleettrace import REPLICA_SEP
+
+        if snapshot:
+            self.snapshot_bundles(timeout_s=timeout_s)
+        dest = os.path.abspath(dest_dir or os.path.join(
+            self.workdir, "collected_bundles"))
+        os.makedirs(dest, exist_ok=True)
+        out: Dict[str, List[str]] = {}
+        for name, rep in sorted(self.replicas.items()):
+            collected: List[str] = []
+            for bundle in rep.bundles():
+                if not os.path.isfile(os.path.join(bundle,
+                                                   "MANIFEST.json")):
+                    continue   # manifest-less partial: incomplete dump
+                target = os.path.join(
+                    dest, f"{name}{REPLICA_SEP}"
+                          f"{os.path.basename(bundle)}")
+                try:
+                    if not os.path.isdir(target):
+                        shutil.copytree(bundle, target)
+                    collected.append(target)
+                # fcheck: ok=swallowed-error (one uncopyable bundle
+                # must not abort the fleet collection; the per-replica
+                # counts in the return value carry the shortfall)
+                except OSError:
+                    continue
+            out[name] = collected
+            self._reg.inc("serve.fleet.bundles_collected",
+                          len(collected))
+            obs_flight.record("fleet_bundle", replica=name,
+                              n_bundles=len(collected))
         return out
 
     # -- router front end ---------------------------------------------

@@ -174,6 +174,12 @@ class JobSpec:
     # never the result — distinct SLOs must share one cache entry.
     slo: Optional[str] = None
     slo_target_ms: Optional[float] = None
+    # fctrace id (router-minted, X-FCTPU-Trace).  Like the SLO it is
+    # OUTSIDE the content hash: a trace identifies one *submission*,
+    # never the result — two traced requests for the same graph must
+    # share one cache entry, and a cache hit still carries the hitting
+    # request's own trace through its flight events.
+    trace: Optional[str] = None
 
     def slo_class(self) -> str:
         """The job's SLO class name (``SLO_CLASSES``)."""
@@ -455,6 +461,7 @@ class Job:
                 "slo": self.spec.slo_class(),
                 "slo_target_ms": self.spec.slo_target(),
                 "content_hash": self.key,
+                "trace": self.spec.trace,
                 "n_nodes": self.spec.n_nodes,
                 "algorithm": self.spec.config.algorithm,
                 "submitted_at": self.submitted_at,

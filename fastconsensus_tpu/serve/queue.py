@@ -164,8 +164,10 @@ class AdmissionQueue:
         # flight append outside _cond: admits race the dispatcher's
         # pop for this lock, and the timeline doesn't need the
         # critical section — only the depth observed inside it
+        trace = getattr(job.spec, "trace", None)
         obs_flight.record("admit", job=job.job_id,
-                          priority=job.spec.priority, depth=depth)
+                          priority=job.spec.priority, depth=depth,
+                          **({"trace": trace} if trace else {}))
 
     def _note_promotion(self, heap, popped_seq: int,
                         priority: int) -> None:

@@ -57,6 +57,7 @@ import hashlib
 import itertools
 import json
 import logging
+import os
 import threading
 import time
 import urllib.error
@@ -67,6 +68,9 @@ from typing import Any, Dict, FrozenSet, List, Optional, Tuple
 from fastconsensus_tpu.analysis.footprint import (MIN_EDGE_CLASS,
                                                   MIN_NODE_CLASS, grid_up)
 from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.obs import flight as obs_flight
+from fastconsensus_tpu.obs import latency as obs_latency
+from fastconsensus_tpu.obs.fleettrace import TRACE_HEADER, aggregate_fleet
 
 _logger = logging.getLogger("fastconsensus_tpu")
 
@@ -250,10 +254,12 @@ class _ReplicaView:
 class _RouterJob:
     """One forwarded submission's bookkeeping: enough to replay it."""
 
-    def __init__(self, fleet_id: str, body: bytes, key: str) -> None:
+    def __init__(self, fleet_id: str, body: bytes, key: str,
+                 trace: Optional[str] = None) -> None:
         self.fleet_id = fleet_id
         self.body = body                 # the raw /submit JSON bytes
         self.route_key = key
+        self.trace = trace               # fctrace id (X-FCTPU-Trace)
         self.replica: Optional[str] = None
         self.replica_job_id: Optional[str] = None
         self.content_hash: Optional[str] = None
@@ -263,14 +269,18 @@ class _RouterJob:
 
 
 def _http_json(url: str, payload_bytes: Optional[bytes] = None,
-               timeout: float = 10.0) -> Tuple[int, Dict[str, Any],
-                                               Dict[str, str]]:
+               timeout: float = 10.0,
+               extra_headers: Optional[Dict[str, str]] = None
+               ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
     """One JSON request; returns (status, body, headers).  HTTP error
     statuses return normally (the router maps them itself); transport
-    errors raise OSError."""
+    errors raise OSError.  ``extra_headers`` is how trace context rides
+    the forwarded hop (fctrace: the X-FCTPU-Trace header)."""
     headers = {"Accept": "application/json"}
     if payload_bytes is not None:
         headers["Content-Type"] = "application/json"
+    if extra_headers:
+        headers.update(extra_headers)
     req = urllib.request.Request(url, data=payload_bytes, headers=headers)
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -316,9 +326,22 @@ class FleetRouter:
         self._hash_holders: Dict[str, set] = {}   # content_hash -> names
         self._assignments: Dict[str, str] = {}    # route key -> last home
         self._seq = itertools.count(1)
+        self._trace_seq = itertools.count(1)
         self._reg = obs_counters.get_registry()
+        # fctrace: router-phase latency (router.phase.*) and the
+        # router's own flight events record into the process-global
+        # registries — same posture as the replica, so /metricsz and
+        # post-mortem bundles of a router host need no special casing.
+        self._lat = obs_latency.get_latency_registry()
+        self._flight = obs_flight.get_flight_recorder()
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
+
+    def _mint_trace(self) -> str:
+        """A fleet-unique trace id: pid + per-router sequence — two
+        routers (or a router restart) can never collide, and the id
+        stays grep-friendly in logs and flight events."""
+        return f"tr-{os.getpid():x}-{next(self._trace_seq):06d}"
 
     # -- lifecycle ----------------------------------------------------
 
@@ -549,20 +572,33 @@ class FleetRouter:
                           len(saturated))
         return fresh + saturated
 
-    def submit(self, body: bytes) -> Tuple[int, Dict[str, Any],
-                                           Dict[str, str]]:
+    def submit(self, body: bytes, trace: Optional[str] = None
+               ) -> Tuple[int, Dict[str, Any], Dict[str, str]]:
         """Forward one ``/submit`` body: home replica first, ring
         successors on 429/503/transport failure.  Returns the
         (status, payload, headers) the router should answer with —
         2xx payloads get the router's own ``job_id`` so /status and
-        /result survive a later replay to a different replica."""
+        /result survive a later replay to a different replica.
+
+        ``trace`` is the client's X-FCTPU-Trace header if it sent one;
+        otherwise the router mints one here.  Either way the id rides
+        the forwarded hop as the same header (the replica folds it into
+        the JobSpec), is stamped on the router's own flight events, and
+        is echoed back to the client in the answer."""
         self._reg.inc("serve.fleet.submits")
+        t0 = time.monotonic()
         try:
             payload = json.loads(body or b"{}")
             key = route_key(payload)
         except (ValueError, TypeError) as e:
             return 400, {"error": f"bad request: {e}"}, {}
-        job = _RouterJob(f"f{next(self._seq):06d}", bytes(body), key)
+        if not trace and isinstance(payload, dict):
+            trace = payload.get("trace")
+        trace = str(trace) if trace else self._mint_trace()
+        job = _RouterJob(f"f{next(self._seq):06d}", bytes(body), key,
+                         trace=trace)
+        self._lat.hist("router.phase.admit").record(
+            time.monotonic() - t0)
         status, out, headers = self._forward(job)
         if status in (200, 202):
             with self._lock:
@@ -572,7 +608,10 @@ class FleetRouter:
                     dropped = self._job_order.pop(0)
                     self._jobs.pop(dropped, None)
             out = dict(out, job_id=job.fleet_id,
-                       fleet_replica=job.replica)
+                       fleet_replica=job.replica, trace=trace)
+            self._flight.record("route", job=job.fleet_id, trace=trace,
+                                replica=job.replica,
+                                cached=bool(out.get("cached")))
             self._maybe_fetch_on_miss(job, out)
         return status, out, headers
 
@@ -581,19 +620,23 @@ class FleetRouter:
         deepest_retry: Optional[float] = None
         shed_seen = False
         last_err: Optional[Tuple[int, Dict[str, Any], Dict[str, str]]] = None
+        t0 = time.monotonic()
         try:
             candidates = self._candidates(job.route_key)
         except NoEligibleReplica as e:
             self._reg.inc("serve.fleet.unroutable")
             return 503, {"error": str(e), "fleet": True,
                          "draining": False}, {}
+        self._lat.hist("router.phase.ring_lookup").record(
+            time.monotonic() - t0)
+        fwd_headers = {TRACE_HEADER: job.trace} if job.trace else None
         for view in candidates:
             if view.name in job.excluded:
                 continue
             try:
                 status, out, headers = _http_json(
                     view.base_url + "/submit", job.body,
-                    timeout=self.timeout)
+                    timeout=self.timeout, extra_headers=fwd_headers)
             except (OSError, ValueError) as e:
                 # transport failure IS a health signal, not just a
                 # routing miss — count it toward the cordon threshold
@@ -655,7 +698,15 @@ class FleetRouter:
             job.excluded.add(exclude_also)
         job.replays += 1
         self._reg.inc("serve.fleet.replays")
+        t0 = time.monotonic()
         status, out, _ = self._forward(job)
+        self._lat.hist("router.phase.replay").record(
+            time.monotonic() - t0)
+        self._flight.record("rehome_replay", job=job.fleet_id,
+                            trace=job.trace, replica=job.replica,
+                            replays=job.replays,
+                            excluded=",".join(sorted(job.excluded)),
+                            ok=status in (200, 202))
         if status in (200, 202):
             self._maybe_fetch_on_miss(job, out)
             return True
@@ -739,10 +790,18 @@ class FleetRouter:
                 view = self._views.get(replica) if replica else None
             if view is None:
                 return 500, {"error": f"job {fleet_id} lost its replica"}
+            t0 = time.monotonic()
             try:
                 status, out, _ = _http_json(
                     f"{view.base_url}/{kind}/{job.replica_job_id}",
                     timeout=self.timeout)
+                # per-replica proxy-overhead attribution (fctrace):
+                # the router-side cost of one proxied hop to THIS
+                # replica — network + replica handler time, the slice
+                # of fleet latency no replica-side histogram can see
+                self._lat.hist("router.phase.proxy",
+                               replica=replica).record(
+                    time.monotonic() - t0)
             except (OSError, ValueError) as e:
                 # the replica died under this job: replay elsewhere and
                 # answer "still pending" — the client's poll loop keeps
@@ -775,6 +834,12 @@ class FleetRouter:
                     if job.content_hash:
                         self._hash_holders.setdefault(
                             job.content_hash, set()).add(replica)
+                # one flight event per COMPLETED proxy, not per poll:
+                # a 2 ms client poll loop would otherwise flood the
+                # bounded rings with thousands of identical events
+                self._flight.record("proxy", job=job.fleet_id,
+                                    trace=job.trace, replica=replica,
+                                    replays=job.replays)
             return status, dict(out, fleet_replica=replica,
                                 fleet_replays=job.replays)
         return 503, {"error": f"job {fleet_id} could not be served "
@@ -808,6 +873,33 @@ class FleetRouter:
                          if k.startswith("serve.fleet.")},
         }
 
+    def fleetz(self) -> Dict[str, Any]:
+        """The ``GET /fleetz`` payload: every replica's ``/metricsz``
+        scraped live and folded into one fleet view (fctrace
+        ``aggregate_fleet``) — latency histograms exact-merged across
+        replicas, SLO met/missed summed per class, plus the router's
+        own ``router.phase.*`` family and per-replica proxy-overhead
+        attribution.  A replica that cannot be scraped is reported
+        ``ok: false``, never silently dropped."""
+        self._reg.inc("serve.fleet.fleetz")
+        with self._lock:
+            targets = [(v.name, v.base_url)
+                       for v in self._views.values()]
+        per_replica: Dict[str, Optional[Dict[str, Any]]] = {}
+        for name, base_url in targets:
+            try:
+                status, m, _ = _http_json(base_url + "/metricsz",
+                                          timeout=self.poll_timeout)
+                per_replica[name] = m if status == 200 else None
+            # fcheck: ok=swallowed-error (the unscrapable replica is
+            # REPORTED — aggregate_fleet marks it ok:false; nothing to
+            # re-raise in an aggregation that must answer regardless)
+            except (OSError, ValueError):
+                per_replica[name] = None
+        return aggregate_fleet(per_replica,
+                               router_latency=self._lat.snapshot(),
+                               router_fleet=self.fleet_stats())
+
 
 # ---------------------------------------------------------------------
 # Router HTTP front end (stdlib http.server, the replica handler's twin)
@@ -817,7 +909,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
     """Routes: POST /submit; GET /status/<id> /result/<id> /healthz
     /metricsz — the same surface as one replica, so every existing
     client (serve/client.py, cli.py --server) talks to the fleet
-    unchanged."""
+    unchanged — plus the router-only fctrace surface: GET /fleetz
+    (exact-merged fleet metrics) and GET /debugz/flight (the router's
+    own trace-stamped flight snapshot)."""
 
     server_version = "fcfleet/1"
     protocol_version = "HTTP/1.1"
@@ -856,9 +950,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 return
             length = int(self.headers.get("Content-Length", "0"))
             body = self.rfile.read(length)
-            status, out, headers = self.router.submit(body)
+            status, out, headers = self.router.submit(
+                body, trace=self.headers.get(TRACE_HEADER))
             hop = {k: v for k, v in headers.items()
                    if k.lower() == "retry-after"}
+            if out.get("trace"):
+                hop[TRACE_HEADER] = str(out["trace"])
             self._send(status, out, headers=hop or None)
         except Exception as e:  # noqa: BLE001 — catch-all status mapping
             self._send_fault(e)
@@ -877,9 +974,22 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._send(200, {"ok": up > 0, "fleet": fleet})
             return
         if path == "/metricsz":
+            # scope self-description (fctrace): these counters and
+            # histograms are ROUTER-local — a scraper must never read
+            # them as fleet totals.  The fleet view lives at /fleetz.
             self._send(200, {
+                "scope": "router",
                 "fcobs": obs_counters.get_registry().snapshot(),
+                "latency": obs_latency.get_latency_registry().snapshot(),
                 "fleet": self.router.fleet_stats()})
+            return
+        if path == "/fleetz":
+            self._send(200, self.router.fleetz())
+            return
+        if path == "/debugz/flight":
+            self._send(200, {
+                "scope": "router",
+                "flight": obs_flight.get_flight_recorder().snapshot()})
             return
         for prefix, fn in (("/status/", self.router.status),
                            ("/result/", self.router.result)):

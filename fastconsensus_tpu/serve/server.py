@@ -194,6 +194,14 @@ class ServeConfig:
     flight_dir: Optional[str] = None
 
 
+def _trace_aux(job) -> Dict[str, Any]:
+    """fctrace: the flight-event aux carrying a job's trace id — empty
+    when the submission carried none, so untraced traffic's events stay
+    byte-identical to before this field existed."""
+    trace = getattr(job.spec, "trace", None)
+    return {"trace": trace} if trace else {}
+
+
 def validate_warm_specs(config: ServeConfig) -> None:
     """Fail fast on ``--warm`` specs the running server could never use.
 
@@ -452,6 +460,7 @@ class ConsensusService:
             rows.append({
                 "job_id": j.job_id,
                 "state": j.state,
+                "trace": j.spec.trace,
                 "bucket": bucket,
                 "priority": j.spec.priority,
                 "device": j.device,
@@ -678,7 +687,7 @@ class ConsensusService:
             self._remember(job)
             self._reg.inc("serve.jobs.cached")
             obs_flight.record("cache_hit", job=job.job_id,
-                              bucket=bucket_key)
+                              bucket=bucket_key, **_trace_aux(job))
             self._record_timeline(job, cached=True)
             return job
         # fcshape deadline-aware shedding: a job the measured service
@@ -694,7 +703,8 @@ class ConsensusService:
             if reason is not None:
                 self._reg.inc("serve.queue.rejected_shed")
                 obs_flight.record("shed", job=job.job_id,
-                                  bucket=bucket_key, depth=depth)
+                                  bucket=bucket_key, depth=depth,
+                                  **_trace_aux(job))
                 shed = DeadlineShed(depth, self.queue.max_depth, reason)
                 shed.retry_after_s = self.shaper.retry_after_s(
                     depth, bucket_key)
@@ -801,7 +811,8 @@ class ConsensusService:
                            priority=job.spec.priority).record(e2e)
             self._reg.inc("serve.slo.missed")
             self._reg.inc(f"serve.slo.{cls}.missed")
-            obs_flight.record("fail", job=job.job_id, bucket=bucket_key)
+            obs_flight.record("fail", job=job.job_id, bucket=bucket_key,
+                              **_trace_aux(job))
             return
         tags = dict(bucket=bucket_key, rung=0 if cached else int(rung),
                     priority=job.spec.priority, device=device)
@@ -827,7 +838,8 @@ class ConsensusService:
             e2e, exemplar=job.job_id)
         obs_flight.record("finish", job=job.job_id, bucket=bucket_key,
                           e2e_s=round(e2e, 6),
-                          rung=0 if cached else int(rung))
+                          rung=0 if cached else int(rung),
+                          **_trace_aux(job))
         verdict = "met" if e2e * 1000.0 <= job.spec.slo_target() \
             else "missed"
         self._reg.inc(f"serve.slo.{verdict}")
@@ -1459,9 +1471,17 @@ def _parse_spec(payload: Dict[str, Any],
         if not slo_target_ms > 0:
             raise ValueError(
                 f"slo_target_ms must be > 0, got {slo_target_ms}")
+    # fctrace id: set in the body by a direct client, or injected by
+    # the handler from the X-FCTPU-Trace header the router forwards.
+    # Bounded because it is stamped verbatim into flight-event aux.
+    trace = payload.get("trace")
+    if trace is not None:
+        trace = str(trace)
+        if not 0 < len(trace) <= 128:
+            raise ValueError("trace id must be 1..128 characters")
     return JobSpec(edges=edges, n_nodes=n_nodes, config=config,
                    priority=priority, slo=slo,
-                   slo_target_ms=slo_target_ms)
+                   slo_target_ms=slo_target_ms, trace=trace)
 
 
 def _result_json(result: Dict[str, Any]) -> Dict[str, Any]:
@@ -1557,6 +1577,12 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             payload = self._read_json()
+            # fctrace propagation: the header the router forwards wins
+            # over a body-level trace — the router's id is the one its
+            # own flight events and the client's answer already carry
+            header_trace = self.headers.get("X-FCTPU-Trace")
+            if header_trace:
+                payload["trace"] = header_trace
             spec = _parse_spec(payload, self.service.config.max_edges)
         except GraphTooLarge as e:
             self._send(413, {"error": str(e)})
@@ -1596,6 +1622,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(202 if job.state == STATE_QUEUED else 200,
                    {"job_id": job.job_id, "state": job.state,
                     "content_hash": job.key,
+                    "trace": job.spec.trace,
                     "cached": job.state == STATE_DONE})
 
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
@@ -1611,11 +1638,25 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, {"ok": True, **stats})
             return
         if path == "/metricsz":
-            self._send(200, {"fcobs": self.service._reg.snapshot(),
+            # "scope" is fctrace self-description: this block is ONE
+            # replica's view — the router's /metricsz says "router",
+            # and the fleet-wide exact merge lives at the router's
+            # /fleetz.  A scraper can no longer mistake one process's
+            # counters for fleet totals.
+            self._send(200, {"scope": "replica",
+                             "fcobs": self.service._reg.snapshot(),
                              "serve": self.service.stats(),
                              "devices": self.service.device_stats(),
                              "latency": self.service.latency_stats(),
                              "shaping": self.service.shaping_stats()})
+            return
+        if path == "/debugz/flight":
+            # fctrace: the live trace-stamped flight snapshot (with the
+            # monotonic<->wall anchor), so the CI drill can assert a
+            # trace id spans router and replica without killing anyone
+            self._send(200, {"scope": "replica",
+                             "flight":
+                             obs_flight.get_flight_recorder().snapshot()})
             return
         if path == "/debugz/slowest":
             # fcflight tail exemplars: the bucket-worst serve.e2e jobs

@@ -245,16 +245,16 @@ if [ $rc -ne 0 ]; then
 fi
 
 echo "== fcheck-contract: committed inventory & README appendix drift =="
-# the committed runs/contract_r17.json and the README counters
+# the committed runs/contract_r18.json and the README counters
 # reference are both generated from the writer inventory; regenerate
 # each and diff so a new counter cannot land without refreshing them
 JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
     fastconsensus_tpu/ --no-jaxpr --quiet \
     --emit-inventory /tmp/fc_contract_inv.json
-if ! diff -u runs/contract_r17.json /tmp/fc_contract_inv.json; then
-    echo "runs/contract_r17.json is stale — regenerate with" \
+if ! diff -u runs/contract_r18.json /tmp/fc_contract_inv.json; then
+    echo "runs/contract_r18.json is stale — regenerate with" \
          "python -m fastconsensus_tpu.analysis fastconsensus_tpu/" \
-         "--no-jaxpr --emit-inventory runs/contract_r17.json" >&2
+         "--no-jaxpr --emit-inventory runs/contract_r18.json" >&2
     exit 1
 fi
 JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
@@ -408,11 +408,11 @@ snapshot = client.metricsz()
 json.dumps(snapshot)          # /metricsz stays JSON end to end
 # ISSUE 14 runtime cross-check: every metric name the LIVE server
 # emits after real traffic must union cleanly with the committed
-# static writer inventory (runs/contract_r17.json) — closes the
+# static writer inventory (runs/contract_r18.json) — closes the
 # static-model-vs-reality loop for the contract pass
 from fastconsensus_tpu.analysis import contracts
 
-n_checked = contracts.assert_covered(snapshot, "runs/contract_r17.json")
+n_checked = contracts.assert_covered(snapshot, "runs/contract_r18.json")
 print(f"fcserve smoke ok: cache hit served, {rejected} burst "
       f"rejection(s), {len(accepted)} burst job(s) completed, "
       f"{n_checked} live metric name(s) covered by the inventory")
@@ -1304,24 +1304,24 @@ fi
 echo "fcflight smoke ok: cordon-on-stall, SIGQUIT dump, reader round-trip"
 
 echo "== fcfault: injection-site inventory drift =="
-# runs/faults_r17.json is generated from the fault pass's raise-set
+# runs/faults_r18.json is generated from the fault pass's raise-set
 # analysis; regenerate and diff so a new raise site (or a moved
 # boundary) cannot land without refreshing the committed claims the
 # injection campaign below tests against
 JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
     fastconsensus_tpu/ --no-jaxpr --quiet \
     --emit-fault-inventory /tmp/fc_fault_inv.json
-if ! diff -u runs/faults_r17.json /tmp/fc_fault_inv.json; then
-    echo "runs/faults_r17.json is stale — regenerate with" \
+if ! diff -u runs/faults_r18.json /tmp/fc_fault_inv.json; then
+    echo "runs/faults_r18.json is stale — regenerate with" \
          "python -m fastconsensus_tpu.analysis fastconsensus_tpu/" \
-         "--no-jaxpr --emit-fault-inventory runs/faults_r17.json" >&2
+         "--no-jaxpr --emit-fault-inventory runs/faults_r18.json" >&2
     exit 1
 fi
 echo "fault inventory in sync with the raise-set analysis"
 
 echo "== fcfault: 3-site injection campaign (queue / device / drain path) =="
 # Every site's statically claimed absorbing boundary
-# (runs/faults_r17.json) is tested against a LIVE loopback pool: the
+# (runs/faults_r18.json) is tested against a LIVE loopback pool: the
 # injected job fails as itself, failure counters are stamped, sibling
 # jobs complete, and SIGTERM drain still exits 0.
 FAULT_DIR=$(mktemp -d)
@@ -1439,9 +1439,15 @@ trap 'rm -rf "$SMOKE_DIR" "$SERVE_DIR" "$BATCH_DIR" "$POOL_DIR" "$FAULT_DIR" "$F
 # whole failover story: rolling drain exits 0 under the armed fault,
 # the client sees zero failed/stranded jobs, the cordon re-homes the
 # victim's groups, and resubmitting a job the corpse served comes back
-# as a submit-time cache hit from the inherited spill on a live replica
+# as a submit-time cache hit from the inherited spill on a live replica.
+# Since r18 the drill also pins the fctrace story: one trace id spans
+# the router's and the victim's flight snapshots, /fleetz's merge is
+# bit-exact against the per-replica scrapes, and the post-kill
+# collect_bundles + render CLI reconstructs one >=2-track timeline.
 JAX_PLATFORMS=cpu timeout -k 10 600 python - "$FLEET_DIR" <<'PYEOF'
 import json
+import os
+import subprocess
 import sys
 import threading
 import time
@@ -1489,9 +1495,53 @@ try:
             sub = client.submit(**payload(bi, seed))
             client.wait(sub["job_id"], timeout=120)
             records.append((keys[bi], payload(bi, seed),
-                            sub.get("fleet_replica")))
-    assert any(rep == victim for _, _, rep in records), \
+                            sub.get("fleet_replica"), sub.get("trace")))
+    assert any(rep == victim for _, _, rep, _ in records), \
         f"ring precompute lied: {victim} served nothing"
+
+    # fctrace (a): one trace id spans the tiers — the id a
+    # victim-served submission came back with must appear in BOTH the
+    # router's and the victim replica's /debugz/flight snapshots
+    vic_trace = next(tr for _, _, rep, tr in records if rep == victim)
+    assert vic_trace and vic_trace.startswith("tr-"), vic_trace
+
+    def flight_traces(snap):
+        fl = snap.get("flight", {})
+        return {e.get("trace") for ring in fl.get("rings", [])
+                for e in ring.get("events", [])}
+
+    assert vic_trace in flight_traces(client.flight()), \
+        f"{vic_trace} missing from the router's flight snapshot"
+    vic_client = ServeClient(fleet.replicas[victim].base_url,
+                             timeout=10.0)
+    assert vic_trace in flight_traces(vic_client.flight()), \
+        f"{vic_trace} missing from the victim's flight snapshot"
+
+    # fctrace (c): the /fleetz merge is EXACT — every merged
+    # histogram's count equals the sum of the per-replica /metricsz
+    # counts for the same (name, tags).  Read pre-kill, while all
+    # three replicas are scrapeable and the fleet is quiescent.
+    def hist_counts(hists):
+        out = {}
+        for h in hists:
+            k = (h["name"], tuple(sorted((h.get("tags") or {}).items())))
+            out[k] = out.get(k, 0) + int(h["count"])
+        return out
+
+    rep_hists = []
+    for name in names:
+        rep_client = ServeClient(fleet.replicas[name].base_url,
+                                 timeout=10.0)
+        assert rep_client.scope() == "replica", name
+        rep_hists += (rep_client.metricsz().get("latency") or {}
+                      ).get("histograms") or []
+    fz = client.fleetz()
+    assert fz.scope == "fleet", fz.scope
+    assert not fz.replicas_down, fz.replicas_down
+    merged_counts = {(h.name, tuple(sorted(h.tags.items()))): h.count
+                     for h in fz.histograms}
+    assert hist_counts(rep_hists) == merged_counts, \
+        "/fleetz merged counts != sum of per-replica counts"
     # >=3 spill cycles: the armed shot eats the first dirty spill, the
     # next one persists the victim's results for inheritance
     time.sleep(1.6)
@@ -1547,7 +1597,7 @@ try:
     cordoned = frozenset(r["name"] for r in stats["replicas"]
                          if r["state"] == "cordoned")
     resub = None
-    for key, pay, rep in records:
+    for key, pay, rep, _ in records:
         if rep == victim and fleet.router.ring.route(
                 key, cordoned) == successor:
             resub = client.submit(**pay)
@@ -1556,10 +1606,31 @@ try:
         "no victim-served group re-homed to the successor"
     assert resub.get("cached") is True, resub
     assert resub.get("fleet_replica") not in (None, victim), resub
+
+    # fctrace (b): the incident is reconstructable AFTER the kill —
+    # collect every replica's bundles (SIGQUIT snapshots from the
+    # survivors, the corpse's flight dirs as-is) and the jax-free
+    # render CLI merges them into ONE clock-aligned timeline with
+    # >=2 replica tracks in monotonic wall order
+    dest = os.path.join(workdir, "collected")
+    collected = fleet.collect_bundles(dest)
+    assert sum(len(v) for v in collected.values()) >= 2, collected
+    render = subprocess.run(
+        [sys.executable, "-m", "fastconsensus_tpu.obs.fleettrace",
+         "render", dest, "--json"],
+        capture_output=True, text=True, timeout=60)
+    assert render.returncode == 0, render.stderr
+    tl = json.loads(render.stdout)
+    assert tl["tool"] == "fctrace-timeline", tl
+    assert len(tl["replicas"]) >= 2, tl["replicas"]
+    assert tl["n_events"] == len(tl["events"]) > 0, tl["n_events"]
+    walls = [e["t_wall"] for e in tl["events"]]
+    assert walls == sorted(walls), "merged events not in wall order"
 finally:
     fleet.stop_all()
 print("fcfleet drill ok: drain 0, zero failed, re-home counted, "
-      "inherited-cache hit on resubmit")
+      "inherited-cache hit on resubmit, one trace spans tiers, "
+      "fleetz merge exact, fleet timeline merged")
 PYEOF
 rc=$?
 if [ $rc -ne 0 ]; then
@@ -1569,7 +1640,7 @@ fi
 # negative probe: a copy whose chaos drill lost jobs, sequenced one
 # later, must FAIL check_serve_fleet naming the drill rule (a gate
 # that can't fail is no gate)
-python - runs/bench_serve_fleet_r17.json \
+python - runs/bench_serve_fleet_r18.json \
     "$FLEET_DIR/bench_serve_fleet_r99.json" <<'PYEOF'
 import json
 import sys
@@ -1579,7 +1650,7 @@ doc["telemetry"]["serve_fleet"]["drill"]["burst"]["failed"] = 3
 json.dump(doc, open(sys.argv[2], "w"))
 PYEOF
 out=$(python scripts/bench_report.py --check --quiet \
-    runs/bench_serve_fleet_r17.json \
+    runs/bench_serve_fleet_r18.json \
     "$FLEET_DIR/bench_serve_fleet_r99.json" 2>&1)
 rc=$?
 if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "chaos drill lost"; then

@@ -1,0 +1,538 @@
+"""fctrace: trace propagation, exact fleet aggregation, incident merge.
+
+Pins the PR-18 observability contracts: a router-minted trace id rides
+one submission end-to-end (router route event -> forwarded header ->
+replica JobSpec -> replica flight events); ``/fleetz`` merges replica
+histograms bit-exactly (cross-process reuse of the PR-9 fixed-bucket
+merge); and ``fleettrace render`` aligns N per-process bundle dirs
+onto one wall clock.  The reader side (fleettrace CLI, typed client
+blocks) must all run with jax poisoned — incident tooling runs on
+boxes where the engine cannot import.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from fastconsensus_tpu.obs import fleettrace, latency
+
+
+# -- exact-merge aggregation (the /fleetz payload) ---------------------
+
+
+def test_three_concurrent_registries_merge_bit_exact():
+    """The tentpole merge contract, cross-process shaped: 3 replica
+    registries record concurrently (own registry + one combined
+    reference), then fold through aggregate_fleet — the fleet view's
+    counts, buckets, and quantiles must be IDENTICAL to the single
+    registry that saw every sample."""
+    import numpy as np
+
+    regs = [latency.LatencyRegistry() for _ in range(3)]
+    combined = latency.LatencyRegistry()
+    lock = threading.Lock()
+    rngs = [np.random.default_rng(seed) for seed in range(3)]
+
+    def writer(i):
+        for k in range(1500):
+            v = float(rngs[i].lognormal(mean=-5.0, sigma=2.0))
+            bucket = f"n64_e{96 + 32 * (k % 2)}"
+            regs[i].hist("serve.e2e", bucket=bucket).record(v)
+            with lock:
+                combined.hist("serve.e2e", bucket=bucket).record(v)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    fz = fleettrace.aggregate_fleet({
+        f"r{i}": {"scope": "replica", "latency": regs[i].snapshot(),
+                  "fcobs": {"counters": {}}}
+        for i in range(3)})
+    assert all(v["ok"] for v in fz["replicas"].values())
+    merged = {(h["name"], tuple(sorted(h["tags"].items()))): h
+              for h in fz["latency"]["histograms"]}
+    ref = {(h["name"], tuple(sorted(h["tags"].items()))): h
+           for h in combined.snapshot()["histograms"]}
+    assert set(merged) == set(ref) and len(ref) == 2
+    for key, want in ref.items():
+        got = merged[key]
+        assert got["sources"] == 3
+        assert got["count"] == want["count"] == 2250
+        assert got["buckets"] == want["buckets"]
+        assert got["min_s"] == want["min_s"]
+        assert got["max_s"] == want["max_s"]
+        for q in ("p50_s", "p95_s", "p99_s"):
+            assert got[q] == want[q], (key, q)
+
+
+def test_aggregate_fleet_reports_down_replicas_and_sums_slo():
+    """An unscrapable replica must surface as ok:false (never vanish),
+    SLO met/missed must ADD per class with attainment recomputed from
+    the sums, and numeric counters must sum (bools excluded)."""
+    r0 = latency.LatencyRegistry()
+    r0.hist("serve.e2e", bucket="b").record(0.01)
+    m0 = {"scope": "replica", "latency": dict(
+        r0.snapshot(), slo={"interactive": {
+            "met": 8, "missed": 2, "attainment": 0.8,
+            "target_default_ms": 1000.0}}),
+        "fcobs": {"counters": {"serve.jobs": 10, "flag": True}}}
+    m1 = {"scope": "replica", "latency": {
+        "histograms": [], "slo": {"interactive": {
+            "met": 9, "missed": 1, "attainment": 0.9,
+            "target_default_ms": 1000.0}}},
+        "fcobs": {"counters": {"serve.jobs": 4}}}
+    fz = fleettrace.aggregate_fleet({"a": m0, "b": m1, "dead": None})
+    assert fz["scope"] == "fleet" and fz["schema"] == fleettrace.SCHEMA
+    assert fz["replicas"]["dead"] == {"ok": False}
+    assert fz["replicas"]["a"]["ok"] and fz["replicas"]["a"][
+        "scope"] == "replica"
+    slo = fz["slo"]["interactive"]
+    assert (slo["met"], slo["missed"]) == (17, 3)
+    assert slo["attainment"] == pytest.approx(0.85)
+    # the class target must survive the fold: the typed client parses
+    # the fleet slo rows with the same SloStats block as a replica's
+    assert slo["target_default_ms"] == 1000.0
+    assert fz["counters"]["serve.jobs"] == 14
+    assert "flag" not in fz["counters"]
+
+
+def test_proxy_overhead_attribution_per_replica():
+    """router.phase.proxy histograms tagged replica=<name> become the
+    per-replica overhead table — the router-side cost no replica
+    histogram can see."""
+    rl = latency.LatencyRegistry()
+    for v in (0.001, 0.002, 0.004):
+        rl.hist("router.phase.proxy", replica="r0").record(v)
+    rl.hist("router.phase.proxy", replica="r1").record(0.5)
+    rl.hist("router.phase.admit").record(0.0001)  # not proxy: ignored
+    oh = fleettrace.proxy_overhead(rl.snapshot())
+    assert set(oh) == {"r0", "r1"}
+    assert oh["r0"]["count"] == 3 and oh["r1"]["count"] == 1
+    assert oh["r1"]["p95_s"] >= 0.25
+
+
+# -- incident merge (collected bundles -> one timeline) ----------------
+
+
+def _write_bundle(root, name, anchor_unix, anchor_mono, events,
+                  manifest_only_anchor=False, no_anchor=False):
+    d = os.path.join(root, name)
+    os.makedirs(d)
+    manifest = {"pid": 4242}
+    flight = {"capacity": 2048, "n_events": len(events), "dropped": 0,
+              "rings": [{"thread": "MainThread", "dropped": 0,
+                         "events": events}]}
+    if not no_anchor:
+        if manifest_only_anchor:
+            manifest.update(time_unix=anchor_unix, time_mono=anchor_mono)
+        else:
+            flight.update(time_unix=anchor_unix, time_mono=anchor_mono)
+    with open(os.path.join(d, "MANIFEST.json"), "w") as fh:
+        json.dump(manifest, fh)
+    with open(os.path.join(d, "flight.json"), "w") as fh:
+        json.dump(flight, fh)
+    return d
+
+
+def test_merged_timeline_aligns_clocks_dedups_and_filters(tmp_path):
+    """Two replicas with DIFFERENT monotonic epochs must interleave on
+    the shared wall clock; duplicate events from repeated snapshots of
+    one ring dedup; --trace filters to one request across tracks; a
+    bundle with no recoverable anchor is skipped, not mis-ordered."""
+    root = str(tmp_path)
+    # r0's monotonic epoch: wall = ts + 1000; r1's: wall = ts + 500
+    _write_bundle(root, "r0__fcflight_a", 2000.0, 1000.0, [
+        {"ts": 1.0, "kind": "route", "job": "f1", "trace": "tr-1"},
+        {"ts": 3.0, "kind": "proxy", "job": "f1", "trace": "tr-1"}])
+    # same replica, second snapshot of the SAME ring: pure duplicates
+    _write_bundle(root, "r0__fcflight_b", 2000.0, 1000.0, [
+        {"ts": 1.0, "kind": "route", "job": "f1", "trace": "tr-1"}])
+    _write_bundle(root, "r1__fcflight_c", 1500.0, 1000.0, [
+        {"ts": 502.0, "kind": "admit", "job": "j1", "trace": "tr-1"},
+        {"ts": 502.5, "kind": "admit", "job": "j2", "trace": "tr-2"}],
+        manifest_only_anchor=True)
+    _write_bundle(root, "r2__fcflight_d", 0.0, 0.0, [
+        {"ts": 9.0, "kind": "finish", "job": "zz"}], no_anchor=True)
+
+    tl = fleettrace.merged_timeline(root)
+    assert tl["replicas"] == ["r0", "r1"]
+    assert tl["skipped_bundles"] == ["r2__fcflight_d"]
+    assert tl["n_events"] == 4  # duplicate deduped, r2 skipped
+    walls = [e["t_wall"] for e in tl["events"]]
+    assert walls == sorted(walls)
+    # the r1 admits (wall 1002, 1002.5) land BETWEEN r0's route (1001)
+    # and proxy (1003): cross-process interleave is the whole point
+    assert [(e["replica"], e["kind"]) for e in tl["events"]] == [
+        ("r0", "route"), ("r1", "admit"), ("r1", "admit"),
+        ("r0", "proxy")]
+
+    one = fleettrace.merged_timeline(root, trace="tr-1")
+    assert one["n_events"] == 3
+    assert {e["replica"] for e in one["events"]} == {"r0", "r1"}
+    assert all(e["trace"] == "tr-1" for e in one["events"])
+
+    text = fleettrace.render_timeline(one)
+    assert "r0/MainThread: route" in text and "job=j1" in text
+
+
+def test_fleettrace_cli_renders_with_jax_poisoned(tmp_path):
+    """``python -m ...fleettrace render`` is incident tooling: it must
+    produce the merged timeline (and valid --json) in a process where
+    importing jax raises."""
+    root = str(tmp_path / "collected")
+    os.makedirs(root)
+    _write_bundle(root, "r0__fcflight_a", 100.0, 0.0,
+                  [{"ts": 1.0, "kind": "route", "job": "f1",
+                    "trace": "tr-9"}])
+    _write_bundle(root, "r1__fcflight_b", 100.0, 0.0,
+                  [{"ts": 2.0, "kind": "finish", "job": "j1",
+                    "trace": "tr-9"}])
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "from fastconsensus_tpu.obs import fleettrace\n"
+        "rc = fleettrace.main(['render', sys.argv[1], '--json'])\n"
+        "sys.exit(rc)\n")
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env = dict(os.environ, PYTHONPATH=repo)
+    res = subprocess.run([sys.executable, "-c", code, root], cwd=repo,
+                         env=env, capture_output=True, text=True,
+                         timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    payload = json.loads(res.stdout)
+    assert payload["tool"] == "fctrace-timeline"
+    assert payload["replicas"] == ["r0", "r1"]
+    assert payload["n_events"] == 2
+    # empty dir: exit 2, not a traceback
+    empty = str(tmp_path / "empty")
+    os.makedirs(empty)
+    res2 = subprocess.run([sys.executable, "-c", code, empty], cwd=repo,
+                          env=env, capture_output=True, text=True,
+                          timeout=60)
+    assert res2.returncode == 2, res2.stdout + res2.stderr
+
+
+def test_collect_bundles_lays_out_replica_tracks(tmp_path):
+    """FleetManager.collect_bundles (no live procs needed): bundles
+    land as <replica>__<bundle>, manifest-less partials are skipped,
+    and the source dirs stay intact (copy, not move)."""
+    from fastconsensus_tpu.serve.fleet import FleetManager
+
+    fleet = FleetManager(str(tmp_path / "fleet"))
+
+    class _Stub:
+        def __init__(self, dirs):
+            self._dirs = dirs
+
+        def bundles(self):
+            return self._dirs
+
+    src = tmp_path / "r0_flight"
+    good = _write_bundle(str(src), "fcflight_good", 10.0, 0.0,
+                         [{"ts": 0.5, "kind": "admit", "job": "j"}])
+    partial = str(src / "fcflight_partial")
+    os.makedirs(partial)  # no MANIFEST.json: incomplete dump
+    fleet.replicas = {"r0": _Stub([good, partial])}
+
+    dest = str(tmp_path / "collected")
+    out = fleet.collect_bundles(dest_dir=dest, snapshot=False)
+    assert [os.path.basename(p) for p in out["r0"]] == [
+        "r0__fcflight_good"]
+    assert os.path.isfile(os.path.join(
+        dest, "r0__fcflight_good", "flight.json"))
+    assert os.path.isdir(good)  # source untouched
+    pairs = fleettrace.discover_bundles(dest)
+    assert [(r, os.path.basename(d)) for r, d in pairs] == [
+        ("r0", "r0__fcflight_good")]
+
+
+# -- live trace propagation (router -> replica) ------------------------
+
+
+@pytest.fixture
+def replica():
+    """One real loopback replica with its worker NOT started, so queue
+    contents are observable and deterministic."""
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig,
+                                                make_http_server)
+    from fastconsensus_tpu.serve.shaping import ShapingConfig
+
+    svc = ConsensusService(ServeConfig(queue_depth=16, pin_sizing=False,
+                                       shaping=ShapingConfig(shed=False)))
+    httpd = make_http_server(svc, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield svc, f"http://127.0.0.1:{port}"
+    finally:
+        httpd.shutdown()
+        svc.queue.close()
+
+
+def _submit_body(seed, trace=None):
+    payload = {"edges": [[0, 1], [1, 2], [2, 0]], "n_nodes": 8,
+               "algorithm": "lpm", "n_p": 2, "max_rounds": 2,
+               "seed": seed}
+    if trace is not None:
+        payload["trace"] = trace
+    return json.dumps(payload).encode("utf-8")
+
+
+def test_trace_id_spans_router_and_replica(replica):
+    """The tentpole end-to-end: one submission's trace id must appear
+    on the router's route event, in the forwarded header (-> JobSpec),
+    and on the replica's admit flight event — the join key fleettrace
+    stitches cross-process timelines on."""
+    from fastconsensus_tpu.obs import flight as obs_flight
+    from fastconsensus_tpu.serve.router import FleetRouter
+
+    svc, url = replica
+    router = FleetRouter({"r0": url}, poll_s=60.0)
+    router.poll_once()
+    status, out, _ = router.submit(_submit_body(seed=1))
+    assert status == 202, out
+    trace = out["trace"]
+    assert trace and trace.startswith("tr-")
+    job = svc.queue.pop(timeout=5.0)
+    assert job.spec.trace == trace
+    assert job.describe()["trace"] == trace
+    # both tiers run in THIS process here, so one recorder holds both
+    # sides' events — exactly what the kill drill checks across real
+    # processes via /debugz/flight
+    events = obs_flight.get_flight_recorder().events()
+    kinds = {e["kind"] for e in events if e.get("trace") == trace}
+    assert "route" in kinds and "admit" in kinds
+
+    # client-supplied trace wins over minting, body-level trace too
+    status, out2, _ = router.submit(_submit_body(seed=2),
+                                    trace="tr-client-7")
+    assert status == 202 and out2["trace"] == "tr-client-7"
+    assert svc.queue.pop(timeout=5.0).spec.trace == "tr-client-7"
+    status, out3, _ = router.submit(_submit_body(seed=3,
+                                                 trace="tr-body-8"))
+    assert status == 202 and out3["trace"] == "tr-body-8"
+    svc.queue.pop(timeout=5.0)
+
+
+def test_trace_is_outside_the_content_hash(replica):
+    """Two traced submissions of the SAME graph must share one content
+    hash (a trace names a submission, never a result) — and a bogus
+    oversize trace is a 400, not a new cache entry."""
+    svc, url = replica
+    from fastconsensus_tpu.serve.client import ServeClient, ServeError
+
+    client = ServeClient(url, timeout=10.0)
+    a = client._request("/submit", json.loads(
+        _submit_body(seed=5, trace="tr-a").decode()))
+    b = client._request("/submit", json.loads(
+        _submit_body(seed=5, trace="tr-b").decode()))
+    assert a["trace"] == "tr-a" and b["trace"] == "tr-b"
+    assert a["content_hash"] == b["content_hash"]
+    with pytest.raises(ServeError) as err:
+        client._request("/submit", json.loads(
+            _submit_body(seed=6, trace="x" * 200).decode()))
+    assert err.value.status == 400
+    for _ in range(2):
+        svc.queue.pop(timeout=5.0)
+
+
+def test_fleetz_scrapes_live_replica_and_merges_exactly(replica):
+    """router.fleetz() over a live replica: scopes self-describe, the
+    fleet merge's per-histogram counts equal the replica's own
+    /metricsz counts, and the router's phase histograms ride along."""
+    import urllib.request
+
+    from fastconsensus_tpu.serve.router import FleetRouter
+
+    svc, url = replica
+    router = FleetRouter({"r0": url}, poll_s=60.0)
+    router.poll_once()
+    for seed in range(3):
+        status, _, _ = router.submit(_submit_body(seed=seed + 10))
+        assert status == 202
+        svc.queue.pop(timeout=5.0)
+    with urllib.request.urlopen(url + "/metricsz", timeout=10.0) as r:
+        replica_m = json.loads(r.read())
+    assert replica_m["scope"] == "replica"
+    fz = router.fleetz()
+    assert fz["scope"] == "fleet"
+    assert fz["replicas"]["r0"]["ok"]
+    assert fz["replicas"]["r0"]["scope"] == "replica"
+    want = {(h["name"], tuple(sorted(
+        (str(k), str(v)) for k, v in (h.get("tags") or {}).items()))):
+        h["count"]
+        for h in (replica_m.get("latency") or {}).get("histograms", ())}
+    got = {(h["name"], tuple(sorted(
+        (str(k), str(v)) for k, v in (h.get("tags") or {}).items()))):
+        h["count"]
+        for h in fz["latency"]["histograms"]}
+    # one replica: exact merge means count-identity with its scrape
+    # (quiescent between the two reads — the worker never ran)
+    assert got == want
+    router_hists = {h["name"]
+                    for h in fz["router"]["latency"]["histograms"]}
+    assert "router.phase.admit" in router_hists
+    assert "router.phase.ring_lookup" in router_hists
+    # /debugz/flight: the replica's half of the cross-process join
+    with urllib.request.urlopen(url + "/debugz/flight",
+                                timeout=10.0) as r:
+        fl = json.loads(r.read())
+    assert fl["scope"] == "replica"
+    assert fl["flight"].get("time_unix") is not None
+    assert fl["flight"].get("time_mono") is not None
+
+
+# -- typed client blocks (jax-free) ------------------------------------
+
+
+def test_typed_fleet_blocks_parse_with_jax_poisoned():
+    """FleetLatency / TraceTimeline from_payload in a process where
+    jax is poisoned — the fleet dashboard never pays the engine
+    import."""
+    canned_fleetz = {
+        "schema": 1, "tool": "fctrace-fleetz", "scope": "fleet",
+        "replicas": {"r0": {"ok": True, "scope": "replica",
+                            "histograms": 2, "slo": {}},
+                     "r1": {"ok": False}},
+        "latency": {"histograms": [
+            {"name": "serve.e2e", "tags": {"bucket": "n64_e96"},
+             "sources": 2, "count": 10, "sum_s": 0.5, "min_s": 0.01,
+             "max_s": 0.2, "p50_s": 0.03125, "p95_s": 0.25,
+             "p99_s": 0.25, "buckets": {"-5": 10}}]},
+        "slo": {"interactive": {"met": 9, "missed": 1,
+                                "attainment": 0.9,
+                                "target_default_ms": 1000.0}},
+        "counters": {"serve.jobs": 10},
+        "router": {
+            "latency": {"histograms": [
+                {"name": "router.phase.admit", "tags": {}, "count": 10,
+                 "sum_s": 0.001, "min_s": 0.0001, "max_s": 0.0002,
+                 "p50_s": 0.0001, "p95_s": 0.0002, "p99_s": 0.0002,
+                 "buckets": {"-13": 10}}]},
+            "proxy_overhead": {"r0": {"count": 10, "sum_s": 0.02,
+                                      "p50_s": 0.001, "p95_s": 0.003}}},
+    }
+    canned_timeline = {
+        "schema": 1, "tool": "fctrace-timeline", "trace": "tr-1",
+        "replicas": ["r0", "r1"], "n_events": 2,
+        "events_per_replica": {"r0": 1, "r1": 1},
+        "skipped_bundles": ["r2__fcflight_x"],
+        "events": [
+            {"t_wall": 1001.0, "replica": "r0", "thread": "t",
+             "ts": 1.0, "kind": "route", "job": "f1", "trace": "tr-1"},
+            {"t_wall": 1002.0, "replica": "r1", "thread": "t",
+             "ts": 2.0, "kind": "admit", "job": "j1", "trace": "tr-1"}],
+    }
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "import json\n"
+        "from fastconsensus_tpu.serve.client import (FleetLatency,\n"
+        "    TraceTimeline)\n"
+        f"fz = json.loads({json.dumps(json.dumps(canned_fleetz))})\n"
+        f"tl = json.loads({json.dumps(json.dumps(canned_timeline))})\n"
+        "f = FleetLatency.from_payload(fz)\n"
+        "assert f.scope == 'fleet'\n"
+        "assert f.replicas_ok == {'r0': True, 'r1': False}\n"
+        "assert f.replicas_down == ('r1',)\n"
+        "h = f.histogram('serve.e2e', bucket='n64_e96')\n"
+        "assert h is not None and h.count == 10\n"
+        "assert f.histogram('serve.e2e', bucket='nope') is None\n"
+        "assert f.slo[0].met == 9 and f.counters['serve.jobs'] == 10\n"
+        "assert f.router_histograms[0].name == 'router.phase.admit'\n"
+        "assert f.proxy_overhead['r0']['p95_s'] == 0.003\n"
+        "t = TraceTimeline.from_payload(tl)\n"
+        "assert t.trace == 'tr-1' and t.n_events == 2\n"
+        "assert t.replicas == ('r0', 'r1')\n"
+        "assert t.skipped_bundles == ('r2__fcflight_x',)\n"
+        "assert [e['kind'] for e in t.for_replica('r1')] == ['admit']\n"
+        "print('jax-free fleet parse ok')\n")
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env = dict(os.environ, PYTHONPATH=repo)
+    res = subprocess.run([sys.executable, "-c", code], cwd=repo, env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "jax-free fleet parse ok" in res.stdout
+
+
+# -- the CI gate (history.check_fleet_latency) -------------------------
+
+
+def _fl_rec(seq, fl):
+    return {"seq": seq, "source": f"bench_serve_fleet_r{seq}.json",
+            "fleet_latency": fl}
+
+
+def _healthy_fl(**over):
+    fl = {"replicas_scraped": 3, "replicas_down": [],
+          "merge_exact": True,
+          "router_phase_p95_ms": {"admit": 0.05, "ring_lookup": 0.01,
+                                  "proxy": 2.0, "replay": None},
+          "proxy_overhead_p95_ms": {"r0": 2.0, "r1": 2.5},
+          "fleet_e2e_p95_ms": 40.0,
+          "worst_replica_e2e_p95_ms": 45.0}
+    fl.update(over)
+    return fl
+
+
+def test_check_fleet_latency_absolute_rules():
+    from fastconsensus_tpu.obs import history
+
+    clean = {"c": [_fl_rec(18, _healthy_fl())]}
+    assert history.check_fleet_latency(clean) == []
+
+    down = {"c": [_fl_rec(18, _healthy_fl(replicas_down=["r2"]))]}
+    assert any("could not scrape" in p
+               for p in history.check_fleet_latency(down))
+
+    inexact = {"c": [_fl_rec(18, _healthy_fl(merge_exact=False))]}
+    assert any("inexact" in p
+               for p in history.check_fleet_latency(inexact))
+
+    # merged fleet p95 above the worst component: impossible for a
+    # correct mixture quantile, so the gate calls the merge wrong
+    broken = {"c": [_fl_rec(18, _healthy_fl(
+        fleet_e2e_p95_ms=80.0, worst_replica_e2e_p95_ms=45.0))]}
+    assert any("mixture quantile" in p
+               for p in history.check_fleet_latency(broken))
+
+    # pre-fctrace artifacts pass vacuously
+    assert history.check_fleet_latency(
+        {"c": [{"seq": 17, "source": "s", "fleet_latency": None}]}) == []
+
+
+def test_check_fleet_latency_trajectory_rules():
+    from fastconsensus_tpu.obs import history
+
+    hist = [_fl_rec(16, _healthy_fl()), _fl_rec(17, _healthy_fl())]
+    ok = {"c": hist + [_fl_rec(18, _healthy_fl(
+        fleet_e2e_p95_ms=60.0, worst_replica_e2e_p95_ms=62.0))]}
+    assert history.check_fleet_latency(ok) == []
+
+    # e2e p95 more than doubles the prior median: finding
+    slow = {"c": hist + [_fl_rec(18, _healthy_fl(
+        fleet_e2e_p95_ms=90.0, worst_replica_e2e_p95_ms=95.0))]}
+    assert any("tail regressed" in p
+               for p in history.check_fleet_latency(slow))
+
+    # worst-replica proxy overhead grows past its own bound: finding
+    hop = {"c": hist + [_fl_rec(18, _healthy_fl(
+        proxy_overhead_p95_ms={"r0": 2.0, "r1": 9.0}))]}
+    assert any("proxy overhead" in p
+               for p in history.check_fleet_latency(hop))
+
+    # only the NEWEST sequence is judged: an old bad record is history
+    old_bad = {"c": [_fl_rec(16, _healthy_fl(merge_exact=False)),
+                     _fl_rec(18, _healthy_fl())]}
+    assert history.check_fleet_latency(old_bad) == []
