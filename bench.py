@@ -977,6 +977,262 @@ def bench_serve_load() -> int:
     return 0 if ok else 1
 
 
+def bench_serve_delta() -> int:
+    """The ``serve_delta`` scenario: incremental evolving-graph
+    consensus (fcdelta, serve/delta.py) — drift vs quality vs speedup.
+
+    One lfr1k-shaped base graph is served from scratch (the PARENT:
+    its cached result carries the canonical graph + config lineage),
+    then perturbed by k% of its edges (half removes, half adds) for
+    k in {1, 5, 20} and answered TWICE per k over the real loopback
+    HTTP wire:
+
+    * as a **delta submission** (``POST /submit`` with ``parent`` +
+      adds/removes): the server resolves the parent's cached ensemble,
+      warm-starts from it, and restricts moves to the changed edges'
+      neighborhood — or falls back to a from-scratch run when the
+      policy says the drift is too large (k=20 > the 10% ceiling, the
+      fallback demo);
+    * as a plain **from-scratch twin** of the same perturbed graph
+      (seed bumped so its content hash never collides with anything
+      cached) — the honest baseline every incremental claim is judged
+      against.
+
+    Per scenario it reports the policy verdict (mode/reason/
+    delta_frac), device time, rounds and NMI-vs-planted-truth for both
+    runs, the device-time speedup, and the warm-compile count across
+    the delta run — which must be ZERO: the frontier mask and warm
+    labels are data, not shape, so the incremental path must reuse the
+    exact bucketed executables the parent compiled.  The delta runs
+    are submitted FIRST within each scenario so the derived-key cache
+    row is provably a live run, not a replay.  The artifact's
+    ``telemetry.serve_delta`` block is gated by
+    ``obs/history.check_delta``; the bench's own exit code enforces
+    the ISSUE acceptance (at k <= 5: incremental device time <= 0.5x
+    from-scratch, NMI within 0.02, zero warm compiles; k=20 falls
+    back; delta-class SLO attainment 1.0).
+
+    Env knobs: FCTPU_SERVE_DELTA_KS (default "1,5,20"),
+    FCTPU_SERVE_DELTA_N / _NP / _ROUNDS (graph size 1000, ensemble 8,
+    round budget 32 — CPU-tractable lfr1k posture),
+    FCTPU_SERVE_DELTA_SLO_MS (per-submission delta SLO target
+    override; empty uses the class default),
+    FCTPU_SERVE_DELTA_OUT (also write the JSON artifact to a file —
+    runs/bench_serve_delta_rNN.json is the committed, gated shape).
+    """
+    os.environ.setdefault("FCTPU_DETECT_CALL_MEMBERS", "0")
+    # block of 4, not the serving default 8: round cost is paid per
+    # block regardless of early convergence inside it, and the whole
+    # point here is that the warm run CONVERGES IN FEWER ROUNDS on the
+    # same executables — coarse blocks would quantize that saving away
+    # (both runs share one process and one block size, so the
+    # comparison stays apples-to-apples at any value)
+    os.environ.setdefault("FCTPU_ROUNDS_BLOCK", "4")
+    import threading
+
+    import jax
+    import numpy as np
+
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve import delta as fcdelta
+    from fastconsensus_tpu.serve.client import ServeClient
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig,
+                                                make_http_server)
+    from fastconsensus_tpu.serve.shaping import ShapingConfig
+    from fastconsensus_tpu.utils import synth
+    from fastconsensus_tpu.utils.metrics import nmi
+
+    ks = [int(x) for x in os.environ.get(
+        "FCTPU_SERVE_DELTA_KS", "1,5,20").split(",")]
+    n = int(os.environ.get("FCTPU_SERVE_DELTA_N", "1000"))
+    n_p = int(os.environ.get("FCTPU_SERVE_DELTA_NP", "8"))
+    max_rounds = int(os.environ.get("FCTPU_SERVE_DELTA_ROUNDS", "32"))
+    slo_ms = os.environ.get("FCTPU_SERVE_DELTA_SLO_MS")
+    out_path = os.environ.get("FCTPU_SERVE_DELTA_OUT")
+
+    edges_raw, truth = synth.lfr_graph(n, 0.3, seed=42)
+    # canonicalize bench-side exactly like the server (u < v, deduped,
+    # sorted) so the perturbation machinery and the parent's cached
+    # graph block agree edge-for-edge
+    e = np.asarray(edges_raw, np.int64)
+    u0, v0 = np.minimum(e[:, 0], e[:, 1]), np.maximum(e[:, 0], e[:, 1])
+    keep = u0 != v0
+    u0, v0 = u0[keep], v0[keep]
+    order = np.argsort(u0 * n + v0, kind="stable")
+    u0, v0 = u0[order], v0[order]
+    dedup = np.ones(u0.shape[0], bool)
+    dedup[1:] = (u0[1:] != u0[:-1]) | (v0[1:] != v0[:-1])
+    u0, v0 = u0[dedup], v0[dedup]
+    n_edges = int(u0.shape[0])
+
+    reg = obs_counters.get_registry()
+    svc = ConsensusService(ServeConfig(
+        queue_depth=8, pin_sizing=False, devices=1,
+        shaping=ShapingConfig(shed=False))).start()
+    httpd = make_http_server(svc, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout=60.0)
+    config = dict(algorithm="louvain", n_p=n_p, tau=0.2, delta=0.02,
+                  max_rounds=max_rounds, seed=0)
+
+    def device_s(res):
+        t = res.get("timing") or {}
+        return float((t.get("phases_ms") or {}).get("device", 0.0)) / 1000.0
+
+    def run_nmi(res):
+        return round(float(nmi(np.asarray(res["partitions"][0]), truth)), 5)
+
+    scenarios: list = []
+    parent_rounds = None
+    attainment = None
+    try:
+        sub = client.submit(edges=np.stack([u0, v0], 1).tolist(),
+                            n_nodes=n, **config)
+        parent_hash = sub["content_hash"]
+        parent_res = client.wait(sub["job_id"], timeout=900)
+        parent_rounds = parent_res["rounds"]
+        if not parent_res.get("converged"):
+            print(f"WARNING: the parent run hit max_rounds={max_rounds} "
+                  f"unconverged — every delta will fall back "
+                  f"(parent_unconverged)", file=sys.stderr)
+
+        for k in ks:
+            rng = np.random.default_rng(1000 + k)
+            m = max(2, int(round(n_edges * k / 100.0)))
+            m_rem = m // 2
+            rem_idx = rng.choice(n_edges, size=m_rem, replace=False)
+            removes = np.stack([u0[rem_idx], v0[rem_idx]], 1)
+            eset = set(zip(u0.tolist(), v0.tolist()))
+            adds: list = []
+            while len(adds) < m - m_rem:
+                a, b = (int(x) for x in rng.integers(0, n, size=2))
+                a, b = min(a, b), max(a, b)
+                if a != b and (a, b) not in eset:
+                    eset.add((a, b))
+                    adds.append([a, b])
+            adds_arr = fcdelta.parse_edge_pairs(adds, "adds", n)
+            rem_arr = fcdelta.parse_edge_pairs(removes.tolist(),
+                                               "removes", n)
+
+            # delta FIRST: the child content hash must be uncached when
+            # the delta lands, so the incremental row is a real run
+            base = reg.counters()
+            extra = {"slo_target_ms": float(slo_ms)} if slo_ms else {}
+            dsub = client.submit_delta(parent_hash, adds=adds,
+                                       removes=removes, **extra)
+            dres = client.wait(dsub["job_id"], timeout=900)
+            warm = reg.counters_since(base).get("serve.xla_compiles", 0)
+            dinfo = dsub.get("delta") or {}
+
+            # the from-scratch twin: same perturbed graph, seed bumped
+            # so its content hash collides with nothing cached (the
+            # k=20 fallback cached under the PLAIN child hash — an
+            # identical-config twin would dedup to it and report zero
+            # device time for a run that never happened)
+            cu, cv, _cw = fcdelta.apply_delta(u0, v0, None, n,
+                                              adds_arr, rem_arr)
+            ssub = client.submit(edges=np.stack([cu, cv], 1).tolist(),
+                                 n_nodes=n, **dict(config, seed=1000 + k))
+            sres = client.wait(ssub["job_id"], timeout=900)
+
+            inc_dev, scr_dev = device_s(dres), device_s(sres)
+            scenario = {
+                "k_pct": k,
+                "n_adds": int(adds_arr.shape[0]),
+                "n_removes": int(rem_arr.shape[0]),
+                "expected_mode": "incremental" if k <= 5 else "fallback",
+                "mode": dinfo.get("mode"),
+                "reason": dinfo.get("reason"),
+                "delta_frac": dinfo.get("delta_frac"),
+                "warm_compiles": warm,
+                "incremental": {
+                    "device_s": round(inc_dev, 6),
+                    "e2e_ms": (dres.get("timing") or {}).get("e2e_ms"),
+                    "rounds": dres["rounds"],
+                    "converged": dres.get("converged"),
+                    "nmi": run_nmi(dres),
+                },
+                "scratch": {
+                    "device_s": round(scr_dev, 6),
+                    "rounds": sres["rounds"],
+                    "converged": sres.get("converged"),
+                    "nmi": run_nmi(sres),
+                },
+                "speedup": round(scr_dev / inc_dev, 4)
+                if inc_dev > 0 else None,
+            }
+            scenarios.append(scenario)
+            print(f"serve_delta k={k}%: mode={scenario['mode']} "
+                  f"(reason={scenario['reason']}) device "
+                  f"{inc_dev:.3f}s vs scratch {scr_dev:.3f}s, NMI "
+                  f"{scenario['incremental']['nmi']} vs "
+                  f"{scenario['scratch']['nmi']}, compiles={warm}",
+                  file=sys.stderr)
+        totals = reg.counters()
+        met = totals.get("serve.slo.delta.met", 0)
+        missed = totals.get("serve.slo.delta.missed", 0)
+        attainment = round(met / (met + missed), 4) \
+            if met + missed else None
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        if not svc.drain(300):
+            print("WARNING: serve_delta drain timed out", file=sys.stderr)
+
+    inc = [s for s in scenarios if s["expected_mode"] == "incremental"]
+    headline = inc[0] if inc else scenarios[0]
+    out = {
+        "metric": "serve_delta_speedup",
+        "config": "serve_delta",
+        # HIGHER IS BETTER, but a ratio against an in-artifact twin:
+        # the gate on this artifact is history.check_delta (absolute
+        # per-scenario rules), never the throughput-drop rule
+        "value": headline["speedup"] or 0.0,
+        "unit": f"incremental/scratch device-time speedup at "
+                f"{headline['k_pct']}% drift (lfr n={n}, louvain "
+                f"n_p={n_p})",
+        "converged": all(s["incremental"]["converged"]
+                         for s in scenarios),
+        "n_chips": 1,
+        "mesh": "1x1",
+        "backend": jax.default_backend(),
+        "telemetry": {
+            "compiles_warm": sum(s["warm_compiles"] for s in inc),
+            "serve_delta": {
+                "graph": f"lfr n={n} mu=0.3",
+                "n_edges": n_edges,
+                "parent_rounds": parent_rounds,
+                "max_delta_frac": float(
+                    svc.config.delta_policy.max_delta_frac),
+                "slo_target_ms": float(slo_ms) if slo_ms else None,
+                "scenarios": scenarios,
+                "slo_delta_attainment": attainment,
+            },
+        },
+    }
+    print(json.dumps(out))
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(out, fh, indent=2)
+            fh.write("\n")
+        print(f"serve_delta artifact written to {out_path}",
+              file=sys.stderr)
+    ok = (attainment == 1.0
+          and all(s["mode"] == s["expected_mode"] for s in scenarios)
+          and all(s["warm_compiles"] == 0
+                  and s["incremental"]["device_s"] <=
+                  0.5 * s["scratch"]["device_s"]
+                  and s["incremental"]["nmi"] >=
+                  s["scratch"]["nmi"] - 0.02
+                  for s in inc))
+    if not ok:
+        print("serve_delta: GATE FAILED — see the scenarios block",
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
 def bench_serve_fleet() -> int:
     """The ``serve_fleet`` scenario: horizontal scale-out (fcfleet).
 
@@ -1529,6 +1785,8 @@ def main() -> int:
         return bench_serve_load()
     if name == "serve_fleet":
         return bench_serve_fleet()
+    if name == "serve_delta":
+        return bench_serve_delta()
     cfg = CONFIGS[name]
     edges, truth, variant = make_graph(cfg)
     if variant:

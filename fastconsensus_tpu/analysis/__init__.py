@@ -30,7 +30,7 @@ Seven layers, one report (run ``python -m fastconsensus_tpu.analysis``):
    ``escape-thread-root``, ``swallowed-error``,
    ``unmapped-http-error``, ``resource-leak``.  The committed
    injection-site inventory (``--emit-fault-inventory`` ->
-   ``runs/faults_r18.json``) feeds the opt-in runtime harness
+   ``runs/faults_r19.json``) feeds the opt-in runtime harness
    (serve/faultinject.py, ``FCTPU_FAULT_INJECT=<site_id>``) that the
    ci_check injection campaign drives against a live pool.
 6. **Name contracts** (analysis/contracts.py) — the whole-program
@@ -42,7 +42,7 @@ Seven layers, one report (run ``python -m fastconsensus_tpu.analysis``):
    scripts/ci_check.sh greps, the typed client, the README tables) —
    ``phantom-reader``, ``schema-drift``, ``dead-counter``,
    ``event-vocab``, ``doc-drift``.  Jax-free; the committed
-   ``runs/contract_r18.json`` inventory feeds a live ``/metricsz``
+   ``runs/contract_r19.json`` inventory feeds a live ``/metricsz``
    cross-check (``contracts.assert_covered``).
 7. **Runtime guards** — :class:`CompileGuard`
    (analysis/recompile_guard.py) bounds XLA compilations over a region

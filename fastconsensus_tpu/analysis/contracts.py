@@ -60,7 +60,7 @@ a lone module would make every cross-module name look phantom.
 
 **Runtime cross-check** — :func:`assert_covered` takes a live
 ``/metricsz`` snapshot and the committed inventory artifact
-(``runs/contract_r18.json``, written by ``--emit-inventory``) and
+(``runs/contract_r19.json``, written by ``--emit-inventory``) and
 asserts every observed name unions cleanly with the static writer
 templates; scripts/ci_check.sh runs it inside the loopback serve
 smoke, closing the static-model-vs-reality loop the same way the
@@ -1083,7 +1083,7 @@ def check_contracts(sources: Dict[str, str]
 # ---------------------------------------------------------------------------
 
 def build_inventory(sources: Dict[str, str], pkg_root: str) -> dict:
-    """The committed artifact (runs/contract_r18.json): writer
+    """The committed artifact (runs/contract_r19.json): writer
     templates, wire keys, event vocabulary and reader sites — the
     static half of the runtime cross-check, and what
     ``bench_report --check`` and the README appendix validate

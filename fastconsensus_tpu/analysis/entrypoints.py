@@ -382,7 +382,9 @@ def trace_serving_executable(kind: str, n_class: int, e_class: int,
         return jax.make_jaxpr(fn)(
             slab, sds((), key_aval.dtype), sds((n_p, n), jnp.int32),
             sds((), jnp.int32), sds((), jnp.int32), sds((), jnp.bool_),
-            pst, sds((), jnp.bool_), sds((3,), jnp.int32))
+            pst, sds((), jnp.bool_), sds((3,), jnp.int32),
+            # fcdelta traced inputs: active mask + warm-round-0 flag
+            sds((n,), jnp.bool_), sds((), jnp.bool_))
     if kind == "batch":
         assert mode in ("warm", "cold", "scratch"), mode
         d = det_warm if mode == "warm" else det

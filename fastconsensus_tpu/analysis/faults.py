@@ -76,7 +76,7 @@ Four rules:
 
 The runtime half closes the loop the way ``analysis/lockorder.py``
 does for the lock-order rule: ``--emit-fault-inventory`` writes
-``runs/faults_r18.json`` — every raise site in ``serve/`` plus the
+``runs/faults_r19.json`` — every raise site in ``serve/`` plus the
 boundary this pass claims absorbs it — and ``serve/faultinject.py``
 (``FCTPU_FAULT_INJECT=<site_id>``) patches any inventoried site to
 throw on demand, so the ci_check injection campaign can assert per
@@ -938,7 +938,7 @@ class FaultAnalyzer:
 
     def build_inventory(self, module_prefix: str =
                         "fastconsensus_tpu.serve") -> dict:
-        """The committed injection-site inventory (runs/faults_r18.
+        """The committed injection-site inventory (runs/faults_r19.
         json): every raise site in ``serve/`` (explicit raise or
         curated builtin raiser) + the boundary this pass claims
         absorbs it.  ``injectable`` marks sites serve/faultinject.py
@@ -1077,7 +1077,7 @@ def fault_inventory_from_paths(paths: List[str]) -> dict:
     """Load every ``.py`` under ``paths`` the way lint_paths does and
     build the injection-site inventory — the ``--emit-fault-inventory``
     entry point (scripts/ci_check.sh regenerates and diffs the
-    committed runs/faults_r18.json through it)."""
+    committed runs/faults_r19.json through it)."""
     import os
 
     files: List[str] = []

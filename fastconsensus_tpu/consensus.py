@@ -300,7 +300,9 @@ def run_consensus(slab: GraphSlab,
                   resume: bool = False,
                   on_round=None,
                   detect_cache_dir: Optional[str] = None,
-                  n_closure: Optional[int] = None) -> ConsensusResult:
+                  n_closure: Optional[int] = None,
+                  init_labels=None,
+                  active_mask=None) -> ConsensusResult:
     """Host-side driver: iterate jitted rounds to delta-convergence.
 
     With ``mesh`` (a ``jax.sharding.Mesh`` from parallel/sharding.py) the
@@ -329,6 +331,20 @@ def run_consensus(slab: GraphSlab,
     edge class here — distinct graphs padded into one size bucket then
     share executables instead of each compiling its own round over its
     own exact edge count.
+
+    ``init_labels`` / ``active_mask`` (fcdelta, serve/delta.py): seed the
+    whole run from a PRIOR run's final partitions (``init_labels``
+    [n_p, n_nodes] int32) instead of the singleton cold start — round 0
+    runs the capped-sweep warm detector, exactly like a checkpoint-resumed
+    round — and optionally restrict re-consensus to the vertices inside
+    ``active_mask`` (bool[n_nodes]): vertices outside it keep their
+    init labels through every round AND through the final re-detection
+    (host-side clamp — no extra executables).  Both are traced inputs of
+    the same fused-block executable full runs compile, so an incremental
+    re-run after a full run on the same bucket compiles nothing.
+    Requires a warm-capable detector (``warm_start`` on +
+    ``supports_init``); incompatible with ``mesh`` and with
+    checkpoint/resume.
     """
     if key is None:
         key = jax.random.key(config.seed)
@@ -354,6 +370,32 @@ def run_consensus(slab: GraphSlab,
         "csr" if config.closure_sampler == "auto" else
         config.closure_sampler)
     warm = config.warm_start and getattr(detect, "supports_init", False)
+    # fcdelta masked warm-start entry: validate before any device work
+    if active_mask is not None and init_labels is None:
+        raise ValueError("active_mask requires init_labels (the frozen "
+                         "vertices' labels come from the parent run)")
+    if init_labels is not None:
+        if not warm:
+            raise ValueError(
+                "init_labels requires warm_start=True and a detector "
+                "with supports_init (the warm ensemble IS the reuse)")
+        if mesh is not None:
+            raise ValueError("init_labels is not supported with a mesh")
+        if checkpoint_path is not None or resume:
+            raise ValueError("init_labels is incompatible with "
+                             "checkpoint/resume (two competing notions "
+                             "of 'where the run starts')")
+        init_labels = np.asarray(init_labels, np.int32)
+        if init_labels.shape != (config.n_p, slab.n_nodes):
+            raise ValueError(
+                f"init_labels shape {init_labels.shape} != "
+                f"{(config.n_p, slab.n_nodes)} (n_p, n_nodes)")
+    active_np: Optional[np.ndarray] = None
+    if active_mask is not None:
+        active_np = np.asarray(active_mask, bool)
+        if active_np.shape != (slab.n_nodes,):
+            raise ValueError(f"active_mask shape {active_np.shape} != "
+                             f"({slab.n_nodes},)")
     # Endgame alignment only for detectors whose tie-breaks are
     # content-keyed (louvain._community_reps): without that, sharing keys
     # merely strips the ensemble's key diversity with no collapse mechanism
@@ -400,6 +442,12 @@ def run_consensus(slab: GraphSlab,
         # weights <- 1.0 at loop start (fc:135-136); input weights are
         # ignored, matching the reference (documented in utils/io.py).
         slab = slab.with_weights(jnp.where(slab.alive, 1.0, 0.0))
+    if init_labels is not None:
+        # fcdelta warm-start: the run begins where the parent run ended —
+        # the same posture as a labels-bearing checkpoint resume, so
+        # cold_start_round below becomes -1 and round 0 runs the
+        # capped-sweep warm variant instead of the singleton cold start
+        cur_labels = jnp.asarray(init_labels, jnp.int32)
     # Run-scoped telemetry base (taken AFTER any resume restore): a
     # checkpoint persists saved_counters + the increments since here, so
     # counts an unrelated earlier run left in the process-global registry
@@ -834,6 +882,13 @@ def run_consensus(slab: GraphSlab,
     # same baseline the fused block carries via labels0).  Consumed only
     # by the quality metrics; never fed back into detection.
     prev_round_labels = sing_labels
+    # fcdelta traced block inputs — ALWAYS passed, so full runs and
+    # incremental re-runs share ONE fused-block executable per bucket
+    # (all-True mask + warm0=False selects the identity/cold-start
+    # program bit-for-bit; see engine.consensus_rounds_block).
+    block_active = (jnp.asarray(active_np) if active_np is not None
+                    else jnp.ones((slab.n_nodes,), bool))
+    block_warm0 = jnp.bool_(init_labels is not None)
     r = start_round
     while r < end_round:
         t_iter = time.perf_counter()
@@ -859,7 +914,8 @@ def run_consensus(slab: GraphSlab,
                     jnp.int32(end_round - r), jnp.bool_(align_now(r)),
                     policy.PolicyState(*(jnp.int32(v) for v in pstate)),
                     jnp.bool_(config.auto_grow),
-                    jnp.asarray(noop, jnp.int32))
+                    jnp.asarray(noop, jnp.int32),
+                    block_active, block_warm0)
                 # fcheck: ok=sync-in-loop (ONE bulk readback per block —
                 # round count + stats in a single transfer; the readback
                 # the block fusion exists to amortize)
@@ -958,6 +1014,12 @@ def run_consensus(slab: GraphSlab,
                     # rounds, matching the fused block's carry), the
                     # non-warm path's tracked previous-round labels
                     prev_lab = cur_labels if warm else prev_round_labels
+                    if active_np is not None:
+                        # fcdelta frontier restriction on the split-phase
+                        # path: eager clamp between detect and tail (the
+                        # fused path folds the same where into its block)
+                        labels = jnp.where(block_active[None, :], labels,
+                                           prev_lab)
                     with tracer.span("tail", r=r):
                         slab, stats = _jitted_tail(
                             config.n_p, config.tau, config.delta,
@@ -1005,13 +1067,17 @@ def run_consensus(slab: GraphSlab,
                         # the same executable (no endgame recompile); cold
                         # refresh rounds take singleton init — round 0's
                         # executable.  prev_labels (fcqual churn baseline)
-                        # is always the round's entering labels.
+                        # is always the round's entering labels.  The
+                        # fcdelta active mask is passed only when present:
+                        # full unfused runs keep their exact legacy trace.
                         slab_new, new_labels, stats = round_fn(
                             slab, k,
                             init_labels=sing_labels if is_cold
                             else cur_labels,
                             align=jnp.bool_(align_now(r) and not is_cold),
-                            prev_labels=cur_labels)
+                            prev_labels=cur_labels,
+                            **({"active": block_active}
+                               if active_np is not None else {}))
                     else:
                         slab_new, new_labels, stats = round_fn(
                             slab, k, prev_labels=prev_round_labels)
@@ -1112,6 +1178,14 @@ def run_consensus(slab: GraphSlab,
         all_labels = jax.device_get(final_labels)
     obs_counters.host_sync("final_labels")
     partitions = [all_labels[i] for i in range(config.n_p)]
+    if active_np is not None:
+        # fcdelta: frozen vertices keep the parent ensemble's labels
+        # through the final re-detection too.  Host-side numpy clamp —
+        # zero extra executables, and the serving layer's per-member
+        # recompaction (np.unique) runs downstream of this anyway.
+        frozen = ~active_np
+        partitions = [np.where(frozen, init_labels[i], p)
+                      for i, p in enumerate(partitions)]
     return ConsensusResult(partitions=partitions, graph=slab, rounds=rounds,
                            converged=converged, history=history)
 

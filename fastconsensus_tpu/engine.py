@@ -216,7 +216,8 @@ def consensus_round(slab: GraphSlab,
                     align: bool = False,
                     sampler: str = "scatter",
                     closure_tau: Optional[float] = None,
-                    prev_labels: Optional[jax.Array] = None
+                    prev_labels: Optional[jax.Array] = None,
+                    active: Optional[jax.Array] = None
                     ) -> Tuple[GraphSlab, jax.Array, RoundStats]:
     """One full consensus round.  Jittable; all shapes static.
 
@@ -239,7 +240,20 @@ def consensus_round(slab: GraphSlab,
     per-partition keys and labels to the mesh's ensemble axis; XLA then runs
     each chip's shard of the ensemble locally and contracts the n_p axis of
     the co-membership count with one ``psum`` — the round's only collective.
+
+    ``active`` (traced bool[N], fcdelta) freezes vertices OUTSIDE the mask:
+    after detection their labels are clamped back to the round-entering
+    ``prev_labels`` under a ``where`` — shapes stay static, so an all-True
+    mask is the identity program and full runs share executables with
+    frontier-restricted incremental re-runs.  Requires ``prev_labels``;
+    not supported under ``ensemble_sharding`` (the mesh path never serves
+    delta jobs).  ``None`` (static) compiles no ``where`` at all.
     """
+    if active is not None and ensemble_sharding is not None:
+        raise ValueError("active mask is not supported on the mesh path")
+    if active is not None and prev_labels is None:
+        raise ValueError("active mask requires prev_labels (the freeze "
+                         "source for masked-out vertices)")
     k_detect, k_closure = jax.random.split(key)
     keys = _maybe_align_keys(prng.partition_keys(k_detect, n_p), align)
     if ensemble_sharding is not None:
@@ -266,6 +280,11 @@ def consensus_round(slab: GraphSlab,
         labels = detect(slab, keys, init_labels)
     else:
         labels = detect(slab, keys)
+    if active is not None:
+        # frontier restriction: frozen vertices keep their round-entering
+        # labels no matter what the detector's sweeps did — the move phase
+        # is skipped for them by construction of the consensus input
+        labels = jnp.where(active[None, :], labels, prev_labels)
     if ensemble_sharding is not None:
         # explicit edge-local tail: GSPMD re-gathers the tail's scatters
         # and concatenates capacity-wide (ops/sharded_tail.py docstring);
@@ -317,6 +336,8 @@ def consensus_rounds_block(slab: GraphSlab,
                            pstate0: policy.PolicyState,
                            watch0: jax.Array,
                            noop0: jax.Array,
+                           active0: jax.Array,
+                           warm0: jax.Array,
                            detect: Detector,
                            detect_warm: Detector,
                            detect_refresh: Detector,
@@ -375,6 +396,15 @@ def consensus_rounds_block(slab: GraphSlab,
     rule makes the next round re-detect COLD (singleton init, full sweeps,
     independent keys), and ``policy.observe`` folds each round's stats
     into the carried state exactly as the host's ``record()`` does.
+
+    ``active0`` (traced bool[N]) and ``warm0`` (traced bool) are the
+    fcdelta incremental-consensus inputs, ALWAYS passed so full and delta
+    runs share one executable per bucket: ``active0`` freezes vertices
+    outside the changed-edge neighborhood (all-True = the identity
+    program, the full-run posture) and ``warm0`` makes absolute round 0
+    run the capped-sweep ``detect_warm`` from ``labels0`` (the parent
+    run's partitions) instead of the full-sweep singleton cold start.
+    Stagnation refresh still re-detects cold mid-run either way.
     """
     def empty_stats():
         z = jnp.zeros((block,), jnp.int32)
@@ -405,7 +435,10 @@ def consensus_rounds_block(slab: GraphSlab,
             # `aligned` is exactly "this round will run aligned"
             stall = policy.stalled(jnp, delta, pst, aligned)
             stale = policy.stale(jnp, delta, pst)
-            cold = (start_round + i == 0) | stale | stall
+            # warm0 (fcdelta) downgrades the absolute-round-0 cold start
+            # to a warm round seeded from labels0 (the parent ensemble);
+            # stagnation refreshes still re-detect cold
+            cold = ((start_round + i == 0) & ~warm0) | stale | stall
 
             def run_singleton(d):
                 def go(op):
@@ -417,7 +450,8 @@ def consensus_rounds_block(slab: GraphSlab,
                         s, kk, detect=d, n_p=n_p, tau=tau, delta=delta,
                         n_closure=n_closure, init_labels=sing,
                         align=False, sampler=sampler,
-                        closure_tau=closure_tau, prev_labels=lab)
+                        closure_tau=closure_tau, prev_labels=lab,
+                        active=active0)
                 return go
 
             def run_cold(op):
@@ -436,7 +470,7 @@ def consensus_rounds_block(slab: GraphSlab,
                     s, kk, detect=detect_warm, n_p=n_p, tau=tau,
                     delta=delta, n_closure=n_closure, init_labels=lab,
                     align=al, sampler=sampler, closure_tau=closure_tau,
-                    prev_labels=lab)
+                    prev_labels=lab, active=active0)
 
             slab, labels, st = jax.lax.cond(
                 cold, run_cold, run_warm, (slab, k, labels, aligned))
@@ -447,7 +481,7 @@ def consensus_rounds_block(slab: GraphSlab,
                 slab, k, detect=detect, n_p=n_p, tau=tau, delta=delta,
                 n_closure=n_closure, init_labels=None, align=False,
                 sampler=sampler, closure_tau=closure_tau,
-                prev_labels=prev_lab)
+                prev_labels=prev_lab, active=active0)
             st = st._replace(cold=jnp.bool_(True))
         # fold the round into the carried stagnation state — the same
         # policy.observe the host's record() applies, so fused and
@@ -468,6 +502,8 @@ def consensus_rounds_block(slab: GraphSlab,
              (st.n_alive > noop0[2]))
         return (slab, i + 1, st.converged, buf, labels, aligned, pst, need)
 
+    active0 = jnp.asarray(active0, bool)
+    warm0 = jnp.asarray(warm0, bool)
     pst0 = policy.PolicyState(*(jnp.asarray(v, jnp.int32)
                                 for v in pstate0))
     slab, done, _, buf, labels, _, _, _ = jax.lax.while_loop(

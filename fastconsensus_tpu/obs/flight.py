@@ -51,6 +51,7 @@ Event vocabulary (the serving stack's instrumentation points; the
 ``proxy``          router proxied a status/result poll to a replica
 ``rehome_replay``  router replayed an in-flight job onto a new replica
 ``fleet_bundle``   FleetManager collected a replica's bundles (fctrace)
+``delta``          fcdelta admission: parent resolved, mode decided
 =================  ====================================================
 
 The router tier (serve/router.py) records into the same vocabulary:
@@ -87,7 +88,7 @@ EVENT_KINDS = (
     "admit", "reject_429", "shed", "hold", "pop", "route", "dequeue",
     "device", "device_done", "finish", "fail", "cache_hit", "cordon",
     "requeue", "watchdog_trip", "bundle", "span_open", "span_close",
-    "proxy", "rehome_replay", "fleet_bundle",
+    "proxy", "rehome_replay", "fleet_bundle", "delta",
 )
 
 

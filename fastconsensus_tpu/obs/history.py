@@ -51,6 +51,21 @@ them too.  Since PR 18 those artifacts also carry a
 router-phase p95s, per-replica proxy overhead, the fleet-merged e2e
 p95, the exact-merge verdict); :func:`check_fleet_latency` gates it.
 
+The fcdelta serve_delta artifacts (``runs/bench_serve_delta_rNN.json``,
+written by ``bench.py serve_delta`` — drift-vs-quality scenarios that
+perturb a base graph by k% of its edges and answer each perturbation
+both incrementally (warm-start from the parent's cached ensemble,
+moves frontier-restricted to the changed neighborhood) and from
+scratch) ride the same reader: records keep the block verbatim
+(``serve_delta`` in the normalized record) and :func:`check_delta`
+gates it — absolute rules from the first artifact, because the
+incremental path's whole contract is *relative to the from-scratch run
+in the same artifact*: an incremental answer whose NMI trails its own
+from-scratch twin by more than the band, or that costs as much device
+time as just recomputing, is wrong regardless of history.  Their
+headline value is a speedup ratio, so :func:`check_history` skips its
+value rules for them too.
+
 The fcqual quality block (``telemetry.quality`` — obs/quality.py's
 :func:`~fastconsensus_tpu.obs.quality.summarize_history` output, stamped
 by ``bench.py`` on every run artifact) rides the same reader: records
@@ -112,6 +127,15 @@ DEFAULT_R429_GROWTH = 0.20        # absolute 429-rate growth at ref RPS
 # history.
 DEFAULT_FLEET_SCALING_DROP = 0.15   # fractional efficiency drop vs median
 DEFAULT_FLEET_ATTAIN_MIN = 0.99     # absolute SLO attainment floor/point
+
+# fcdelta (serve_delta) gate thresholds.  Absolute, armed from the
+# first committed artifact: every scenario carries its OWN from-scratch
+# twin, so the comparison never needs history.  The NMI band matches
+# the ISSUE acceptance (incremental quality within 0.02 of scratch);
+# the device bound is the existential one — an "incremental" run that
+# costs at least a from-scratch recompute has no reason to exist.
+DEFAULT_DELTA_NMI_GAP = 0.02        # incremental NMI may trail scratch by
+DEFAULT_DELTA_ATTAIN_MIN = 1.0      # delta-class SLO attainment floor
 
 # fctrace (telemetry.fleet_latency) gate thresholds.  The absolute
 # rules arm from the first committed artifact: an unscrapable replica
@@ -213,6 +237,11 @@ def _normalize(rec: dict, source: str, seq: Optional[int]) -> dict:
         # weak-scaling points + chaos-drill block, kept verbatim for
         # serve_fleet_table() and check_serve_fleet()
         "serve_fleet": tel.get("serve_fleet") or None,
+        # fcdelta serve_delta artifacts (bench.py serve_delta): the
+        # drift-vs-quality scenario block (per-k incremental vs
+        # from-scratch device time / NMI / compiles), kept verbatim
+        # for check_delta()
+        "serve_delta": tel.get("serve_delta") or None,
         # fcflight incident-health block (bench.py serve_load): watchdog
         # trips / bundles written / exemplar count, kept verbatim for
         # check_flight() — a clean sequenced load run that TRIPS the
@@ -740,6 +769,89 @@ def check_serve_fleet(groups: Dict[str, List[dict]],
                     f"fleet size {size} fell below {floor:.3f} "
                     f"({scaling_drop:.0%} drop from the prior median "
                     f"{base:.3f}) — the fleet stopped scaling")
+    return problems
+
+
+def check_delta(groups: Dict[str, List[dict]],
+                nmi_gap: float = DEFAULT_DELTA_NMI_GAP,
+                attain_min: float = DEFAULT_DELTA_ATTAIN_MIN
+                ) -> List[str]:
+    """fcdelta findings over serve_delta records (``bench.py
+    serve_delta`` drift-vs-quality artifacts); [] means the gate
+    passes.  Every rule is **absolute** and judged on the newest
+    sequence only: each scenario carries its own from-scratch twin of
+    the same perturbed graph, so the incremental path's contract —
+    cheaper than recomputing, and nearly as good — is checkable inside
+    one artifact with no history anchor.
+
+    * a scenario whose policy ``mode`` differs from the scenario's
+      ``expected_mode`` (a small drift that fell back, or an oversized
+      one the policy failed to refuse) is a policy regression;
+    * an incremental scenario whose NMI trails its from-scratch twin
+      by more than ``nmi_gap`` broke the quality contract;
+    * an incremental scenario whose device time is >= its from-scratch
+      twin's broke the speed contract — an "incremental" run that
+      costs a full recompute has no reason to exist;
+    * an incremental scenario that compiled anything warm broke the
+      shared-executable contract (the frontier mask and warm labels
+      are data, not shape — bucketed executables must be reused);
+    * delta-class SLO attainment below ``attain_min`` means the new
+      SLO class regressed the moment it shipped.
+    """
+    problems: List[str] = []
+    for config, recs in groups.items():
+        seqd = [r for r in recs if r["seq"] is not None
+                and r.get("serve_delta")]
+        if not seqd:
+            continue
+        latest_seq = max(r["seq"] for r in seqd)
+        for r in seqd:
+            if r["seq"] != latest_seq:
+                continue
+            tag = f"{config} [{r['source']} seq {r['seq']}]"
+            sd = r["serve_delta"]
+            for sc in sd.get("scenarios", ()):
+                k = sc.get("k_pct")
+                mode = sc.get("mode")
+                expected = sc.get("expected_mode")
+                if expected is not None and mode != expected:
+                    problems.append(
+                        f"{tag}: k={k}% perturbation ran "
+                        f"mode={mode!r} (reason "
+                        f"{sc.get('reason')!r}), expected "
+                        f"{expected!r} — the delta policy regressed")
+                    continue
+                if mode != "incremental":
+                    continue  # fallback scenarios ARE the scratch run
+                inc = sc.get("incremental") or {}
+                scr = sc.get("scratch") or {}
+                i_nmi, s_nmi = inc.get("nmi"), scr.get("nmi")
+                if i_nmi is not None and s_nmi is not None and \
+                        i_nmi < s_nmi - nmi_gap:
+                    problems.append(
+                        f"{tag}: k={k}% incremental NMI {i_nmi:.4f} "
+                        f"trails its from-scratch twin {s_nmi:.4f} by "
+                        f"more than {nmi_gap} — warm-start quality "
+                        f"broke")
+                i_dev, s_dev = inc.get("device_s"), scr.get("device_s")
+                if i_dev is not None and s_dev is not None and \
+                        float(i_dev) >= float(s_dev):
+                    problems.append(
+                        f"{tag}: k={k}% incremental device time "
+                        f"{float(i_dev):.4f}s >= from-scratch "
+                        f"{float(s_dev):.4f}s — the warm-start run "
+                        f"costs a full recompute")
+                if sc.get("warm_compiles"):
+                    problems.append(
+                        f"{tag}: k={k}% incremental run compiled "
+                        f"{sc['warm_compiles']} executable(s) warm — "
+                        f"delta runs must reuse the bucketed "
+                        f"executables")
+            att = sd.get("slo_delta_attainment")
+            if att is not None and float(att) < attain_min:
+                problems.append(
+                    f"{tag}: delta-class SLO attainment "
+                    f"{float(att):.3f} below the {attain_min} floor")
     return problems
 
 
@@ -1306,7 +1418,8 @@ def check_history(groups: Dict[str, List[dict]],
         prior_nmi = [r["nmi"] for r in prior if r["nmi"] is not None]
         for r in latest:
             tag = f"{config} [{r['source']} seq {r['seq']}]"
-            if r.get("serve_load") or r.get("serve_fleet"):
+            if r.get("serve_load") or r.get("serve_fleet") \
+                    or r.get("serve_delta"):
                 # latency-curve artifacts are lower-is-better: the
                 # throughput-drop/NMI rules would gate the WRONG
                 # direction (an improvement would "fail").  The tail-
@@ -1315,7 +1428,10 @@ def check_history(groups: Dict[str, List[dict]],
                 # serve_fleet artifacts are higher-is-better scaling
                 # RATIOS, but ratios taken at different largest fleet
                 # sizes are not one trajectory — check_serve_fleet
-                # owns them, anchored on matching size.
+                # owns them, anchored on matching size.  serve_delta
+                # artifacts are speedup ratios vs an in-artifact
+                # from-scratch twin — check_delta owns them with
+                # absolute rules.
                 if (r["compiles_warm"] or 0) > 0:
                     problems.append(
                         f"{tag}: {r['compiles_warm']} warm-run "

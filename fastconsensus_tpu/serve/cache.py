@@ -15,6 +15,18 @@ slow OOM):
   dropped on touch (long-lived servers should not serve arbitrarily
   stale artifacts once operators rotate configs/data around them).
 
+fcdelta lineage pins: a delta submission (serve/delta.py) resolves its
+parent's cached partitions *by reference* during admission — between
+the moment the handler reads the parent hash and the moment the
+warm-start labels are copied out, an LRU eviction or TTL expiry would
+turn an admissible delta into a spurious 404.  :meth:`pin` marks an
+entry unevictable (refcounted — concurrent deltas may share a parent)
+for exactly that resolve window; :meth:`unpin` releases it.  Pinned
+entries are skipped by the LRU eviction loop and survive TTL on touch;
+the cache may transiently exceed ``max_entries`` by the number of live
+pins, which is bounded by in-flight delta admissions.  Counted as
+``serve.cache.parent_pins``.
+
 Every outcome counts itself in the fcobs registry
 (``serve.cache.{hit,miss,insert,evict_lru,expired}`` + the
 ``serve.cache.entries`` gauge), so ``/metricsz`` exposes hit rate
@@ -45,6 +57,9 @@ class ResultCache:
         self._lock = threading.Lock()
         # key -> (stored_at, value); OrderedDict end = most recent
         self._entries: "OrderedDict[str, Tuple[float, Any]]" = OrderedDict()
+        # fcdelta lineage pins: key -> refcount of in-flight delta
+        # admissions currently resolving this entry as their parent
+        self._pins: dict = {}
         self._reg = obs_counters.get_registry()
         # Spill coordination (fcfleet): the periodic background spill
         # and the drain-time spill may race; one coarse lock serializes
@@ -72,7 +87,7 @@ class ResultCache:
                     self._reg.inc("serve.cache.miss")
                 return None
             stored_at, value = entry
-            if now - stored_at > ttl:
+            if now - stored_at > ttl and key not in self._pins:
                 del self._entries[key]
                 self._reg.inc("serve.cache.expired")
                 if count_miss:
@@ -92,10 +107,64 @@ class ResultCache:
             self._entries[key] = (self._clock(), value)
             self._entries.move_to_end(key)
             self._reg.inc("serve.cache.insert")
-            while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
-                self._reg.inc("serve.cache.evict_lru")
+            # evict least-recently-hit UNPINNED entries; a pinned parent
+            # (fcdelta resolve in flight) is skipped even at capacity —
+            # the transient overshoot is bounded by live pins
+            excess = len(self._entries) - self.max_entries
+            if excess > 0:
+                victims = [k for k in self._entries
+                           if k not in self._pins][:excess]
+                for k in victims:
+                    del self._entries[k]
+                    self._reg.inc("serve.cache.evict_lru")
             self._reg.gauge("serve.cache.entries", len(self._entries))
+
+    # -- fcdelta lineage pins ------------------------------------------
+
+    def pin(self, key: str) -> bool:
+        """Hold ``key`` against LRU eviction and TTL expiry for a delta
+        admission's parent-resolve window.  Returns False (and pins
+        nothing) when the entry is absent or already past its TTL —
+        the caller's "parent not cached" signal.  Refcounted: every
+        successful pin needs exactly one :meth:`unpin`."""
+        now = self._clock()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return False
+            if now - entry[0] > self.ttl_seconds and \
+                    key not in self._pins:
+                # already dead, just not collected yet — pinning it
+                # would resurrect an expired artifact
+                del self._entries[key]
+                self._reg.inc("serve.cache.expired")
+                self._reg.gauge("serve.cache.entries",
+                                len(self._entries))
+                return False
+            # fcheck: ok=key-reuse (this `key` is the content-hash
+            # cache-key STRING, not a PRNG key — the name-based
+            # heuristic misfires; strings have no consumption semantics)
+            self._pins[key] = self._pins.get(key, 0) + 1
+            self._reg.inc("serve.cache.parent_pins")
+            return True
+
+    def unpin(self, key: str) -> None:
+        """Release one :meth:`pin`.  Unknown/unpinned keys are a no-op
+        (the pin may have returned False).  An entry that outlived its
+        TTL only because it was pinned drops on the next touch."""
+        with self._lock:
+            # fcheck: ok=key-reuse (cache-key string, not a PRNG key —
+            # same name-based misfire as get() above)
+            n = self._pins.get(key, 0)
+            if n <= 1:
+                self._pins.pop(key, None)  # fcheck: ok=key-reuse
+            else:
+                self._pins[key] = n - 1  # fcheck: ok=key-reuse
+
+    def pinned(self) -> dict:
+        """Snapshot of live pin refcounts (introspection/tests)."""
+        with self._lock:
+            return dict(self._pins)
 
     def __len__(self) -> int:
         with self._lock:
@@ -168,13 +237,36 @@ class ResultCache:
                 # numpy already — this is pure serialization, no device)
                 arr = np.stack([np.asarray(p, dtype=np.int32)
                                 for p in parts])
+                # fcdelta: the canonical graph block rides cached
+                # results so a spilled/inherited parent can still
+                # resolve delta submissions; arrays spill beside the
+                # partitions, never through json
+                graph = payload.pop("graph", None)
+                garr = None
+                if graph is not None:
+                    # host numpy/list blocks — pure spill serialization,
+                    # no device round-trip (hence the pragmas below)
+                    garr = {
+                        "u": np.asarray(  # fcheck: ok=sync-in-loop
+                            graph["u"], dtype=np.int64),
+                        "v": np.asarray(  # fcheck: ok=sync-in-loop
+                            graph["v"], dtype=np.int64),
+                    }
+                    if graph.get("w") is not None:
+                        # fcheck: ok=sync-in-loop (same: host-side spill)
+                        garr["w"] = np.asarray(graph["w"],
+                                               dtype=np.float32)
                 json.dumps(payload)  # everything else must be JSON
             except (TypeError, ValueError, KeyError):
                 self._reg.inc("serve.cache.persist_skipped")
                 continue
             idx = len(meta)
             arrays[f"p{idx}"] = arr
-            meta.append({"key": key, "age": age, "payload": payload})
+            if garr is not None:
+                for name, a in garr.items():
+                    arrays[f"g{idx}{name}"] = a
+            meta.append({"key": key, "age": age, "payload": payload,
+                         "graph": sorted(garr) if garr else None})
         tmp = path + ".tmp"
         with open(tmp, "wb") as fh:
             np.savez(fh, meta=np.frombuffer(
@@ -208,6 +300,11 @@ class ResultCache:
                     value = dict(ent["payload"])
                     value["partitions"] = [arr[i].copy()
                                            for i in range(arr.shape[0])]
+                    if ent.get("graph"):
+                        value["graph"] = {
+                            name: z[f"g{idx}{name}"].copy()
+                            for name in ent["graph"]}
+                        value["graph"].setdefault("w", None)
                     with self._lock:
                         self._entries[ent["key"]] = (now - ent["age"],
                                                      value)

@@ -141,6 +141,41 @@ class JobQuality:
 
 
 @dataclasses.dataclass(frozen=True)
+class DeltaInfo:
+    """A delta submission's fcdelta provenance block (``/submit`` ack,
+    ``/status``, ``/result`` ``delta``), typed: which cached parent the
+    submission evolved (``parent`` is the parent's content hash), the
+    mode the serve-side policy picked (``"incremental"`` — warm-start
+    from the parent's ensemble with moves frontier-restricted to the
+    changed edges' neighborhood — or ``"fallback"`` — a plain
+    from-scratch run), the stable policy-rule name that forced a
+    fallback (None for incremental), and the delta's size: edge-change
+    fraction relative to the parent plus raw add/remove counts.  Lives
+    OUTSIDE the content hash — two submissions producing the same child
+    graph dedup to one cache entry regardless of how they got there."""
+
+    parent: str
+    mode: str
+    reason: Optional[str]
+    delta_frac: float
+    n_adds: int
+    n_removes: int
+
+    @property
+    def incremental(self) -> bool:
+        return self.mode == "incremental"
+
+    @classmethod
+    def from_payload(cls, d: Dict[str, Any]) -> "DeltaInfo":
+        reason = d.get("reason")
+        return cls(parent=str(d["parent"]), mode=str(d["mode"]),
+                   reason=None if reason is None else str(reason),
+                   delta_frac=float(d.get("delta_frac", 0.0)),
+                   n_adds=int(d.get("n_adds", 0)),
+                   n_removes=int(d.get("n_removes", 0)))
+
+
+@dataclasses.dataclass(frozen=True)
 class PhaseLatency:
     """One fclat histogram from ``/metricsz``'s ``latency`` block: a
     log2-bucketed latency distribution (seconds) for one (name, tags)
@@ -569,6 +604,43 @@ class ServeClient:
             payload["priority"] = priority
         return self._request("/submit", payload)
 
+    def submit_delta(self, parent: str, adds=None, removes=None,
+                     priority=None, slo: Optional[str] = None,
+                     slo_target_ms: Optional[float] = None,
+                     trace: Optional[str] = None) -> Dict[str, Any]:
+        """POST /submit with a ``parent`` content hash + edge delta
+        (fcdelta, serve/delta.py).  ``adds``/``removes`` are lists of
+        ``[u, v]`` pairs (numpy arrays accepted) against the parent's
+        node ids; at least one must be non-empty.  The server resolves
+        the parent's cached result, applies the delta to its canonical
+        edge list, and either warm-starts from the parent's ensemble
+        (``mode="incremental"``) or falls back to a from-scratch run —
+        the ack/status/result ``delta`` block says which and why.
+        Delta submissions default to the ``"delta"`` SLO class; pass
+        ``slo`` to override.  Raises :class:`ServeError` with status
+        404 when the parent is not cached (re-submit the full graph)
+        and 400 on a malformed delta (self-loops, out-of-range nodes,
+        removes of absent edges, ... — the error message names the
+        offending list index)."""
+        def _pairs(rows) -> List[List[int]]:
+            rows = rows.tolist() if hasattr(rows, "tolist") else rows
+            return [list(r) for r in rows]
+
+        payload: Dict[str, Any] = {"parent": str(parent)}
+        if adds is not None:
+            payload["adds"] = _pairs(adds)
+        if removes is not None:
+            payload["removes"] = _pairs(removes)
+        if priority is not None:
+            payload["priority"] = priority
+        if slo is not None:
+            payload["slo"] = slo
+        if slo_target_ms is not None:
+            payload["slo_target_ms"] = float(slo_target_ms)
+        if trace is not None:
+            payload["trace"] = trace
+        return self._request("/submit", payload)
+
     def status(self, job_id: str) -> Dict[str, Any]:
         return self._request(f"/status/{job_id}")
 
@@ -647,6 +719,12 @@ class ServeClient:
         computed from pre-fcqual checkpoint histories)."""
         q = self.status(job_id).get("quality")
         return None if q is None else JobQuality.from_payload(q)
+
+    def delta_info(self, job_id: str) -> Optional[DeltaInfo]:
+        """A delta submission's typed fcdelta provenance block (None
+        for plain full-graph submissions and pre-fcdelta servers)."""
+        d = self.status(job_id).get("delta")
+        return None if d is None else DeltaInfo.from_payload(d)
 
     def coalescing(self) -> Dict[str, Any]:
         """Operator view of cross-request batching, extracted from

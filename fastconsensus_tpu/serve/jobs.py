@@ -57,6 +57,12 @@ SLO_CLASSES = {
     "interactive": 1_000.0,
     "normal": 10_000.0,
     "batch": 120_000.0,
+    # fcdelta: incremental re-consensus of a cached parent — a short
+    # frontier-restricted warm-start run, so its latency floor is a
+    # fraction of a full run's and shaping/EDF/shed must treat it to a
+    # tighter promise than "normal".  Delta submissions default here;
+    # it is a legal explicit class for any request.
+    "delta": 2_000.0,
 }
 
 # The per-job phase timeline (fclat): each phase closes at the named
@@ -180,6 +186,16 @@ class JobSpec:
     # share one cache entry, and a cache hit still carries the hitting
     # request's own trace through its flight events.
     trace: Optional[str] = None
+    # fcdelta provenance (serve/delta.py describe_payload dict: parent
+    # hash, mode, reason, delta_frac, counts) — per-SUBMISSION metadata
+    # outside the content hash, stamped on the 202/`/status`/`/result`.
+    delta: Optional[Dict[str, Any]] = None
+    # fcdelta warm-start plumbing (incremental mode only, real-node
+    # sized; the worker pads both to the bucket): the parent's
+    # partitions as init labels and the changed-edge neighborhood as
+    # the move mask.  Outside the hash like every per-submission field.
+    warm_labels: Optional[np.ndarray] = None   # int32 [n_p, n_nodes]
+    warm_active: Optional[np.ndarray] = None   # bool [n_nodes]
 
     def slo_class(self) -> str:
         """The job's SLO class name (``SLO_CLASSES``)."""
@@ -250,6 +266,13 @@ class JobSpec:
             cfg = dataclasses.replace(self.config, seed=0)
             cached = f"{self.bucket().key()}|" \
                      f"{repr(dataclasses.astuple(cfg))}"
+            if self.warm_labels is not None:
+                # fcdelta incremental jobs run SOLO: the batched engine
+                # path carries no per-member init-labels/active-mask,
+                # and coalescing a warm-start job into a cold batch
+                # would silently drop its warm start.  A unique group
+                # key guarantees pop_batch never rides it along.
+                cached += f"|delta-solo:{id(self)}"
             object.__setattr__(self, "_batch_group", cached)
         return cached
 
@@ -475,4 +498,8 @@ class Job:
                 "excluded_devices": sorted(self._excluded),
                 "timing": timing,
                 "quality": quality,
+                # fcdelta provenance: present only for delta
+                # submissions (None otherwise keeps the wire shape
+                # stable for every existing reader)
+                "delta": self.spec.delta,
             }

@@ -107,6 +107,15 @@ def route_key(payload: Dict[str, Any]) -> str:
     heuristic, and a near-bucket-boundary graph landing one class off
     costs one extra warm bucket on one replica, not correctness.
     """
+    parent = payload.get("parent")
+    if parent is not None:
+        # fcdelta (serve/delta.py): a delta submission carries no graph
+        # of its own — only a parent content hash plus edge changes —
+        # so shape-affinity has nothing to hash.  Route on the parent
+        # hash instead: every delta evolving one graph lands on one
+        # replica, which (after the first parent prefetch) holds the
+        # parent entry and answers the whole lineage warm.
+        return f"delta|{parent}"
     if "edgelist" in payload:
         n_edges = sum(1 for ln in str(payload["edgelist"]).splitlines()
                       if ln.strip() and not ln.lstrip().startswith("#"))
@@ -263,6 +272,10 @@ class _RouterJob:
         self.replica: Optional[str] = None
         self.replica_job_id: Optional[str] = None
         self.content_hash: Optional[str] = None
+        # fcdelta: the parent content hash a delta submission names —
+        # set at admit so every forward (first try AND replay) can
+        # prefetch the parent entry into the receiving replica
+        self.parent_hash: Optional[str] = None
         self.excluded: set = set()       # replicas that failed this job
         self.replays = 0
         self.done = False
@@ -597,6 +610,8 @@ class FleetRouter:
         trace = str(trace) if trace else self._mint_trace()
         job = _RouterJob(f"f{next(self._seq):06d}", bytes(body), key,
                          trace=trace)
+        if isinstance(payload, dict) and payload.get("parent"):
+            job.parent_hash = str(payload["parent"])
         self._lat.hist("router.phase.admit").record(
             time.monotonic() - t0)
         status, out, headers = self._forward(job)
@@ -633,6 +648,13 @@ class FleetRouter:
         for view in candidates:
             if view.name in job.excluded:
                 continue
+            if job.parent_hash is not None:
+                # fcdelta: make the parent local BEFORE the delta
+                # arrives — a replica can only resolve a delta against
+                # a parent entry it holds; running this per-candidate
+                # (not once per submit) keeps replays and successor
+                # hops resolvable too
+                self._prefetch_parent(job.parent_hash, view.name)
             try:
                 status, out, headers = _http_json(
                     view.base_url + "/submit", job.body,
@@ -775,6 +797,58 @@ class FleetRouter:
         if holder_urls:
             return
         self._reg.inc("serve.fleet.cache_no_holder")
+
+    def _prefetch_parent(self, parent_hash: str, target: str) -> None:
+        """The forward-looking twin of :meth:`_maybe_fetch_on_miss`,
+        for fcdelta (serve/delta.py): a delta submission is about to
+        be forwarded to ``target``, and it can only resolve there if
+        the PARENT's cached result is local to that replica.  When the
+        hash index says a live sibling holds the parent and ``target``
+        does not, copy it over (``GET /cachez/<hash>`` on the holder,
+        ``POST /cachez`` on the target — the wire shape carries the
+        graph + config lineage blocks) before forwarding, so the
+        replica answers incrementally instead of 404ing a parent the
+        fleet actually has.  No holder anywhere means the 404 the
+        replica will return is the honest fleet-wide answer."""
+        with self._lock:
+            holders = self._hash_holders.get(parent_hash, set())
+            if target in holders or target not in self._views:
+                return
+            sources = [(n, self._views[n].base_url) for n in holders
+                       if n != target and n in self._views
+                       and not self._views[n].cordoned]
+            target_url = self._views[target].base_url
+        if not sources:
+            return
+        for _name, url in sources:
+            try:
+                status, res, _ = _http_json(
+                    url + f"/cachez/{parent_hash}", timeout=self.timeout)
+            # fcheck: ok=swallowed-error (an unreachable holder is a
+            # miss for that holder only; the next source is tried, and
+            # the replica's own parent-miss 404 stays the honest
+            # terminal answer when every source fails)
+            except (OSError, ValueError):
+                continue
+            if status != 200:
+                self._reg.inc("serve.fleet.cache_fetch_misses")
+                continue
+            try:
+                seed_status, _, _ = _http_json(
+                    target_url + "/cachez",
+                    json.dumps(res).encode("utf-8"),
+                    timeout=self.timeout)
+            # fcheck: ok=swallowed-error (a target that cannot accept
+            # the seed will also fail the forward right after — THAT
+            # path owns the error accounting)
+            except (OSError, ValueError):
+                return
+            if seed_status == 200:
+                self._reg.inc("serve.fleet.delta_parent_prefetch")
+                with self._lock:
+                    self._hash_holders.setdefault(
+                        parent_hash, set()).add(target)
+            return
 
     # -- status / result proxy ----------------------------------------
 

@@ -82,10 +82,12 @@ from fastconsensus_tpu.serve.jobs import (PRIORITY_BATCH,
                                           SLO_CLASSES,
                                           STATE_DONE, STATE_FAILED,
                                           STATE_QUEUED, STATE_RUNNING, Job,
-                                          JobSpec)
+                                          JobSpec, hash_canonical)
 from fastconsensus_tpu.serve.queue import (AdmissionQueue, DeadlineShed,
                                            QueueClosed, QueueFull)
 from fastconsensus_tpu.serve.cache import ResultCache
+from fastconsensus_tpu.serve.delta import (DeltaError, DeltaPolicy,
+                                           ParentNotCached)
 from fastconsensus_tpu.serve.shaping import ShapingConfig, TrafficShaper
 from fastconsensus_tpu.serve.watchdog import WatchdogConfig
 
@@ -192,6 +194,11 @@ class ServeConfig:
     # Where post-mortem bundles land (obs/postmortem.py): None falls
     # back to $FCTPU_FLIGHT_DIR, else ./fcflight.
     flight_dir: Optional[str] = None
+    # fcdelta warm-start vs full-run thresholds (serve/delta.py): the
+    # delta-size ceiling and the parent-quality floors an incremental
+    # submission must clear; every tripped rule stamps its name as the
+    # fallback ``reason``.  Frozen, so the shared default is safe.
+    delta_policy: DeltaPolicy = DeltaPolicy()
 
 
 def _trace_aux(job) -> Dict[str, Any]:
@@ -645,7 +652,7 @@ class ConsensusService:
 
     # -- submission --------------------------------------------------
 
-    def submit(self, spec: JobSpec) -> Job:
+    def submit(self, spec: JobSpec, key: Optional[str] = None) -> Job:
         """Admit a job (or answer it from the cache immediately).
 
         Raises :class:`GraphTooLarge` (413), :class:`queue.QueueFull`
@@ -653,6 +660,11 @@ class ConsensusService:
         returned job is either queued, or already DONE when its content
         hash hit the cache — a cache hit costs no queue slot, so cached
         traffic flows even through a saturated queue.
+
+        ``key`` overrides the cache key (fcdelta: incremental results
+        live under :func:`serve.delta.delta_cache_key`, never under the
+        child graph's own content hash — approximate answers must not
+        shadow the exact-dedup promise).
         """
         n_raw = spec.n_edges_raw()
         if n_raw < 1:
@@ -665,7 +677,7 @@ class ConsensusService:
             raise GraphTooLarge(
                 f"graph has {n_raw} edges; this server admits at most "
                 f"{self.config.max_edges}")
-        job = Job(self._normalize_spec(spec))
+        job = Job(self._normalize_spec(spec), key=key)
         bucket_key = None
         try:
             # fclat per-bucket arrival rate: offered load, marked for
@@ -741,6 +753,108 @@ class ConsensusService:
                 pass           # never mask the backpressure signal
             raise
         self._remember(job)
+        return job
+
+    def submit_delta(self, payload: Dict[str, Any]) -> Job:
+        """fcdelta admission: resolve ``payload['parent']`` from the
+        result cache, apply the canonical edge delta, and submit the
+        child graph — warm-started from the parent's partitions with
+        the move phase restricted to the changed edges' neighborhood
+        when the policy allows, else as a plain full run with
+        ``mode="fallback"`` stamped.
+
+        The parent entry is PINNED for exactly the resolve window
+        (``serve.cache.parent_pins``): between reading the hash and
+        copying the warm-start labels out, an LRU eviction or TTL
+        expiry would otherwise turn an admissible delta into a
+        spurious 404 under cache contention.
+
+        Raises :class:`serve.delta.ParentNotCached` (404),
+        :class:`serve.delta.DeltaError` (400), plus everything
+        :meth:`submit` raises.
+        """
+        from fastconsensus_tpu.consensus import ConsensusConfig
+        from fastconsensus_tpu.models.registry import get_detector
+        from fastconsensus_tpu.serve import delta as fcdelta
+
+        parent_hash = payload.get("parent")
+        if not isinstance(parent_hash, str) or not parent_hash:
+            raise DeltaError("parent must be a content-hash string")
+        pinned = self.cache.pin(parent_hash)
+        try:
+            parent = self.cache.get(parent_hash, count_miss=False) \
+                if pinned else None
+            if parent is None:
+                self._reg.inc("serve.delta.parent_miss")
+                raise ParentNotCached(
+                    f"parent {parent_hash[:16]}… is not in this "
+                    f"replica's result cache (expired, evicted, or "
+                    f"never ran here)")
+            graph = parent.get("graph")
+            cfg_dict = parent.get("config")
+            if graph is None or cfg_dict is None:
+                self._reg.inc("serve.delta.parent_miss")
+                raise ParentNotCached(
+                    "parent result carries no graph/config block "
+                    "(cached before fcdelta); resubmit the full graph "
+                    "once to refresh it")
+            n_nodes = int(parent["n_nodes"])
+            adds, removes = fcdelta.parse_delta(payload, n_nodes)
+            pu = np.asarray(graph["u"], dtype=np.int64)
+            pv = np.asarray(graph["v"], dtype=np.int64)
+            pw = graph.get("w")
+            cu, cv, cw = fcdelta.apply_delta(pu, pv, pw, n_nodes,
+                                             adds, removes)
+            config = ConsensusConfig(**cfg_dict)
+            child_hash = hash_canonical((cu, cv, cw), n_nodes, config)
+            parent_bucket = bucketer.bucket_for(
+                n_nodes, max(int(pu.shape[0]), 1))
+            child_bucket = bucketer.bucket_for(
+                n_nodes, max(int(cu.shape[0]), 1))
+            detect = get_detector(config.algorithm, gamma=config.gamma)
+            warm_capable = bool(config.warm_start and
+                                getattr(detect, "supports_init", False))
+            huge = self.config.chip_max_edges is not None and \
+                child_bucket.e_class > self.config.chip_max_edges
+            decision = self.config.delta_policy.decide(
+                int(adds.shape[0] + removes.shape[0]),
+                int(pu.shape[0]), parent, config,
+                parent_bucket.key(), child_bucket.key(),
+                warm_capable, huge=huge)
+            warm_labels = warm_active = key = None
+            if decision.mode == "incremental":
+                # copies — nothing below may reference the cache entry
+                # once the pin releases
+                warm_labels = np.stack(
+                    [np.asarray(p, dtype=np.int32)
+                     for p in parent["partitions"]])
+                warm_active = fcdelta.neighborhood_mask(
+                    cu, cv, n_nodes, adds, removes)
+                key = fcdelta.delta_cache_key(child_hash, parent_hash)
+            self._reg.inc(f"serve.delta.{decision.mode}")
+        finally:
+            if pinned:
+                self.cache.unpin(parent_hash)
+        spec = JobSpec(
+            edges=np.stack([cu, cv], axis=1), n_nodes=n_nodes,
+            config=config, weights=cw,
+            priority=_parse_priority(payload),
+            slo=_parse_slo(payload, default="delta"),
+            slo_target_ms=_parse_slo_target(payload),
+            trace=_parse_trace(payload),
+            delta=fcdelta.describe_payload(
+                parent_hash, decision,
+                int(adds.shape[0]), int(removes.shape[0])),
+            warm_labels=warm_labels, warm_active=warm_active)
+        # (cu, cv, cw) is already canonical ascending edge-key order —
+        # pre-seed the memo so hashing/packing skip the O(E log E) pass
+        object.__setattr__(spec, "_canonical", (cu, cv, cw))
+        job = self.submit(spec, key=key)
+        obs_flight.record("delta", job=job.job_id,
+                          parent=parent_hash[:16], mode=decision.mode,
+                          reason=decision.reason,
+                          delta_frac=decision.delta_frac,
+                          **_trace_aux(job))
         return job
 
     def job(self, job_id: str) -> Optional[Job]:
@@ -1098,6 +1212,20 @@ class ConsensusService:
             "quality": obs_quality.summarize_history(
                 history or [], converged=converged),
         }
+        # fcdelta: the canonical graph + run config ride the CACHED
+        # payload (and the /cachez wire, so a fleet sibling's fetch
+        # keeps lineage) — that is what lets a later delta submission
+        # resolve this result as its parent and rebuild the child
+        # graph server-side.  /result strips the graph block: clients
+        # sent the edges, they don't need them echoed.
+        gu, gv, gw = spec.canonical()
+        result["graph"] = {
+            "u": np.asarray(gu, dtype=np.int64),
+            "v": np.asarray(gv, dtype=np.int64),
+            "w": None if gw is None else np.asarray(gw,
+                                                    dtype=np.float32),
+        }
+        result["config"] = dataclasses.asdict(spec.config)
         if batch_id is not None:
             result["batch_id"] = batch_id
             result["batch_size"] = batch_size
@@ -1248,6 +1376,21 @@ class ConsensusService:
         guard = CompileGuard(registry=self._reg,
                              counter="serve.xla_compiles",
                              thread_ident=threading.get_ident())
+        run_kwargs: Dict[str, Any] = {}
+        if spec.warm_labels is not None:
+            # fcdelta incremental: pad the parent's labels and the
+            # neighborhood mask out to the bucket — pad nodes enter as
+            # frozen singletons (label = own id, active False), exactly
+            # what a cold run converges them to, so bucket padding and
+            # warm-start compose without a special engine path
+            n_real, n_pad = spec.n_nodes, slab.n_nodes
+            init = np.empty((spec.config.n_p, n_pad), dtype=np.int32)
+            init[:, :n_real] = spec.warm_labels
+            init[:, n_real:] = np.arange(n_real, n_pad,
+                                         dtype=np.int32)[None, :]
+            act = np.zeros((n_pad,), dtype=bool)
+            act[:n_real] = spec.warm_active
+            run_kwargs = {"init_labels": init, "active_mask": act}
         self._device_begin(worker,
                            None if job is None else job.job_id,
                            bucket.key())
@@ -1257,7 +1400,8 @@ class ConsensusService:
                 with guard:
                     res = run_consensus(slab, detect, spec.config,
                                         mesh=mesh,
-                                        n_closure=bucket.n_closure)
+                                        n_closure=bucket.n_closure,
+                                        **run_kwargs)
         finally:
             self._device_end(worker,
                              None if job is None else job.job_id,
@@ -1306,6 +1450,16 @@ class ConsensusService:
                                for p in parts]
         if any(p.ndim != 1 for p in value["partitions"]):
             raise ValueError("partitions must be 1-D label arrays")
+        graph = value.get("graph")
+        if graph is not None:
+            # fcdelta lineage survives the fleet wire: a seeded result
+            # must still resolve delta submissions on the new replica
+            value["graph"] = {
+                "u": np.asarray(graph["u"], dtype=np.int64),
+                "v": np.asarray(graph["v"], dtype=np.int64),
+                "w": None if graph.get("w") is None
+                else np.asarray(graph["w"], dtype=np.float32),
+            }
         # stored uncached; a later hit serves dict(value, cached=True)
         # exactly like a locally computed result
         value["cached"] = False
@@ -1442,35 +1596,59 @@ def _parse_spec(payload: Dict[str, Any],
         raise ValueError(f"delta {config.delta} out of range 0..1")
     if config.n_p < 1 or config.max_rounds < 1:
         raise ValueError("n_p and max_rounds must be >= 1")
+    return JobSpec(edges=edges, n_nodes=n_nodes, config=config,
+                   priority=_parse_priority(payload),
+                   slo=_parse_slo(payload),
+                   slo_target_ms=_parse_slo_target(payload),
+                   trace=_parse_trace(payload))
+
+
+def _parse_priority(payload: Dict[str, Any]) -> int:
+    """Priority from a submit body (shared by the full and delta
+    paths)."""
     prio = payload.get("priority", PRIORITY_NORMAL)
     if isinstance(prio, str):
         if prio not in PRIORITY_NAMES:
             raise ValueError(
                 f"unknown priority {prio!r}; one of "
                 f"{', '.join(PRIORITY_NAMES)} or an int")
-        priority = PRIORITY_NAMES[prio]
-    else:
-        priority = int(prio)
-        if not PRIORITY_INTERACTIVE <= priority <= PRIORITY_BATCH:
-            # unclamped ints would let any client jump ahead of every
-            # documented class — the priority scheme is an enforced
-            # contract, not a suggestion
-            raise ValueError(
-                f"priority {priority} out of range "
-                f"{PRIORITY_INTERACTIVE}..{PRIORITY_BATCH}")
-    slo = payload.get("slo")
+        return PRIORITY_NAMES[prio]
+    priority = int(prio)
+    if not PRIORITY_INTERACTIVE <= priority <= PRIORITY_BATCH:
+        # unclamped ints would let any client jump ahead of every
+        # documented class — the priority scheme is an enforced
+        # contract, not a suggestion
+        raise ValueError(
+            f"priority {priority} out of range "
+            f"{PRIORITY_INTERACTIVE}..{PRIORITY_BATCH}")
+    return priority
+
+
+def _parse_slo(payload: Dict[str, Any],
+               default: Optional[str] = None) -> Optional[str]:
+    """SLO class from a submit body; ``default`` is fcdelta's — a delta
+    submission lands in the ``delta`` class unless it asks otherwise."""
+    slo = payload.get("slo", default)
     if slo is not None:
         slo = str(slo)
         if slo not in SLO_CLASSES:
             raise ValueError(
                 f"unknown slo class {slo!r}; one of "
                 f"{', '.join(SLO_CLASSES)}")
+    return slo
+
+
+def _parse_slo_target(payload: Dict[str, Any]) -> Optional[float]:
     slo_target_ms = payload.get("slo_target_ms")
     if slo_target_ms is not None:
         slo_target_ms = float(slo_target_ms)
         if not slo_target_ms > 0:
             raise ValueError(
                 f"slo_target_ms must be > 0, got {slo_target_ms}")
+    return slo_target_ms
+
+
+def _parse_trace(payload: Dict[str, Any]) -> Optional[str]:
     # fctrace id: set in the body by a direct client, or injected by
     # the handler from the X-FCTPU-Trace header the router forwards.
     # Bounded because it is stamped verbatim into flight-event aux.
@@ -1479,15 +1657,23 @@ def _parse_spec(payload: Dict[str, Any],
         trace = str(trace)
         if not 0 < len(trace) <= 128:
             raise ValueError("trace id must be 1..128 characters")
-    return JobSpec(edges=edges, n_nodes=n_nodes, config=config,
-                   priority=priority, slo=slo,
-                   slo_target_ms=slo_target_ms, trace=trace)
+    return trace
 
 
 def _result_json(result: Dict[str, Any]) -> Dict[str, Any]:
     out = dict(result)
     out["partitions"] = [np.asarray(p).tolist()
                          for p in result["partitions"]]
+    graph = out.get("graph")
+    if graph is not None:
+        # the /cachez wire shape (fleet fetch-on-miss must preserve
+        # fcdelta lineage); /result pops the block before calling here
+        out["graph"] = {
+            "u": np.asarray(graph["u"]).tolist(),
+            "v": np.asarray(graph["v"]).tolist(),
+            "w": None if graph.get("w") is None
+            else np.asarray(graph["w"]).tolist(),
+        }
     return out
 
 
@@ -1583,6 +1769,11 @@ class _Handler(BaseHTTPRequestHandler):
             header_trace = self.headers.get("X-FCTPU-Trace")
             if header_trace:
                 payload["trace"] = header_trace
+            if payload.get("parent") is not None:
+                # fcdelta: a delta submit carries no edges of its own —
+                # the child graph is rebuilt from the cached parent
+                self._submit_delta(payload)
+                return
             spec = _parse_spec(payload, self.service.config.max_edges)
         except GraphTooLarge as e:
             self._send(413, {"error": str(e)})
@@ -1596,22 +1787,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(413, {"error": str(e)})
             return
         except QueueFull as e:
-            # THE backpressure response: explicit, immediate, retryable
-            # — and honest: Retry-After derives from queued depth x the
-            # observed per-bucket service rate (serve/shaping.py), not
-            # a literal guess.  The header is integer delta-seconds
-            # (RFC 9110, rounded up so it never under-promises); the
-            # body carries the unrounded float for typed clients.
-            retry_s = e.retry_after_s
-            if retry_s is None:
-                retry_s = self.service.shaper.config.retry_after_default_s
-            self._send(429, {"error": str(e), "backpressure": True,
-                             "shed": isinstance(e, DeadlineShed),
-                             "retry_after_s": round(retry_s, 3),
-                             "queue_depth": e.depth,
-                             "queue_max_depth": e.max_depth},
-                       headers={"Retry-After":
-                                str(max(1, math.ceil(retry_s)))})
+            self._send_backpressure(e)
             return
         except QueueClosed as e:
             self._send(503, {"error": str(e), "draining": True})
@@ -1619,11 +1795,64 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             self._send(400, {"error": str(e)})
             return
-        self._send(202 if job.state == STATE_QUEUED else 200,
-                   {"job_id": job.job_id, "state": job.state,
-                    "content_hash": job.key,
-                    "trace": job.spec.trace,
-                    "cached": job.state == STATE_DONE})
+        self._send_submit_ack(job)
+
+    def _send_backpressure(self, e: QueueFull) -> None:
+        # THE backpressure response: explicit, immediate, retryable
+        # — and honest: Retry-After derives from queued depth x the
+        # observed per-bucket service rate (serve/shaping.py), not
+        # a literal guess.  The header is integer delta-seconds
+        # (RFC 9110, rounded up so it never under-promises); the
+        # body carries the unrounded float for typed clients.
+        retry_s = e.retry_after_s
+        if retry_s is None:
+            retry_s = self.service.shaper.config.retry_after_default_s
+        self._send(429, {"error": str(e), "backpressure": True,
+                         "shed": isinstance(e, DeadlineShed),
+                         "retry_after_s": round(retry_s, 3),
+                         "queue_depth": e.depth,
+                         "queue_max_depth": e.max_depth},
+                   headers={"Retry-After":
+                            str(max(1, math.ceil(retry_s)))})
+
+    def _send_submit_ack(self, job: Job) -> None:
+        ack = {"job_id": job.job_id, "state": job.state,
+               "content_hash": job.key,
+               "trace": job.spec.trace,
+               "cached": job.state == STATE_DONE}
+        if job.spec.delta is not None:
+            # fcdelta: the client learns the warm-start verdict at
+            # submit time (mode / fallback reason / delta_frac), not
+            # only after polling the result
+            ack["delta"] = job.spec.delta
+        self._send(202 if job.state == STATE_QUEUED else 200, ack)
+
+    def _submit_delta(self, payload: Dict[str, Any]) -> None:
+        """fcdelta POST /submit with ``parent``: full status mapping —
+        404 parent-not-cached, 400 malformed delta (with the offending
+        ``adds[i]``/``removes[i]`` index), then the standard 413/429/
+        503 admission surface."""
+        try:
+            job = self.service.submit_delta(payload)
+        except ParentNotCached as e:
+            self._send(404, {"error": str(e),
+                             "parent": payload.get("parent")})
+            return
+        except GraphTooLarge as e:
+            self._send(413, {"error": str(e)})
+            return
+        except QueueFull as e:
+            self._send_backpressure(e)
+            return
+        except QueueClosed as e:
+            self._send(503, {"error": str(e), "draining": True})
+            return
+        except (ValueError, TypeError, KeyError) as e:
+            # DeltaError is a ValueError: the line-numbered parse
+            # message IS the payload
+            self._send(400, {"error": f"bad delta request: {e}"})
+            return
+        self._send_submit_ack(job)
 
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
         try:
@@ -1686,7 +1915,13 @@ class _Handler(BaseHTTPRequestHandler):
                 if prefix == "/status/":
                     self._send(200, job.describe())
                 elif job.state == STATE_DONE:
-                    out = _result_json(job.result)
+                    # fcdelta: the graph block is cache lineage, not a
+                    # client answer — the client sent the edges (or the
+                    # delta); echoing a million edges back would bloat
+                    # every /result for a field only /cachez needs
+                    res = dict(job.result)
+                    res.pop("graph", None)
+                    out = _result_json(res)
                     # the timing block is PER SUBMISSION, never cached
                     # content: two jobs sharing one cached result each
                     # report their own lifecycle, so it rides the Job,
@@ -1694,6 +1929,10 @@ class _Handler(BaseHTTPRequestHandler):
                     timing = job.timing()
                     if timing is not None:
                         out["timing"] = timing
+                    if job.spec.delta is not None:
+                        # per-submission like timing: a cache hit on a
+                        # delta key still reports ITS OWN provenance
+                        out["delta"] = job.spec.delta
                     self._send(200, out)
                 elif job.state == STATE_FAILED:
                     self._send(500, job.describe())

@@ -21,7 +21,7 @@ transport where jax init hangs, the gate must still run.
 
 ``--check`` additionally validates every metric key this gate reads
 against the committed fcheck-contract inventory
-(``runs/contract_r18.json``) before judging anything: a gate reading a
+(``runs/contract_r19.json``) before judging anything: a gate reading a
 renamed counter is vacuously green forever, so phantom keys fail fast
 with exit 2.  ``fastconsensus_tpu.analysis.contracts`` is safe to
 import here — the package ``__init__`` is lazy and the analysis layer
@@ -88,7 +88,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="emit the trend report as markdown tables")
     p.add_argument("--inventory", metavar="PATH",
                    default=os.path.join(REPO, "runs",
-                                        "contract_r18.json"),
+                                        "contract_r19.json"),
                    help="fcheck-contract inventory artifact; with "
                         "--check, every metric key this gate reads is "
                         "validated against it at startup so a renamed "
@@ -203,6 +203,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     # the fcfleet scaling + chaos-drill gate (absolute drill health,
     # scaling-efficiency trajectory at matching fleet size)
     problems += history.check_serve_fleet(groups)
+    # the fcdelta incremental-consensus gate: per-scenario absolute
+    # rules against the in-artifact from-scratch twin (NMI band,
+    # device-time bound, policy mode, warm compiles, delta-class SLO)
+    problems += history.check_delta(groups)
     # the fctrace fleet-latency gate: unscrapable replicas, an inexact
     # /fleetz histogram merge, fleet-merged e2e p95 / proxy-overhead
     # trajectory

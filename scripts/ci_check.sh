@@ -245,16 +245,16 @@ if [ $rc -ne 0 ]; then
 fi
 
 echo "== fcheck-contract: committed inventory & README appendix drift =="
-# the committed runs/contract_r18.json and the README counters
+# the committed runs/contract_r19.json and the README counters
 # reference are both generated from the writer inventory; regenerate
 # each and diff so a new counter cannot land without refreshing them
 JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
     fastconsensus_tpu/ --no-jaxpr --quiet \
     --emit-inventory /tmp/fc_contract_inv.json
-if ! diff -u runs/contract_r18.json /tmp/fc_contract_inv.json; then
-    echo "runs/contract_r18.json is stale — regenerate with" \
+if ! diff -u runs/contract_r19.json /tmp/fc_contract_inv.json; then
+    echo "runs/contract_r19.json is stale — regenerate with" \
          "python -m fastconsensus_tpu.analysis fastconsensus_tpu/" \
-         "--no-jaxpr --emit-inventory runs/contract_r18.json" >&2
+         "--no-jaxpr --emit-inventory runs/contract_r19.json" >&2
     exit 1
 fi
 JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
@@ -408,11 +408,11 @@ snapshot = client.metricsz()
 json.dumps(snapshot)          # /metricsz stays JSON end to end
 # ISSUE 14 runtime cross-check: every metric name the LIVE server
 # emits after real traffic must union cleanly with the committed
-# static writer inventory (runs/contract_r18.json) — closes the
+# static writer inventory (runs/contract_r19.json) — closes the
 # static-model-vs-reality loop for the contract pass
 from fastconsensus_tpu.analysis import contracts
 
-n_checked = contracts.assert_covered(snapshot, "runs/contract_r18.json")
+n_checked = contracts.assert_covered(snapshot, "runs/contract_r19.json")
 print(f"fcserve smoke ok: cache hit served, {rejected} burst "
       f"rejection(s), {len(accepted)} burst job(s) completed, "
       f"{n_checked} live metric name(s) covered by the inventory")
@@ -1304,24 +1304,24 @@ fi
 echo "fcflight smoke ok: cordon-on-stall, SIGQUIT dump, reader round-trip"
 
 echo "== fcfault: injection-site inventory drift =="
-# runs/faults_r18.json is generated from the fault pass's raise-set
+# runs/faults_r19.json is generated from the fault pass's raise-set
 # analysis; regenerate and diff so a new raise site (or a moved
 # boundary) cannot land without refreshing the committed claims the
 # injection campaign below tests against
 JAX_PLATFORMS=cpu python -m fastconsensus_tpu.analysis \
     fastconsensus_tpu/ --no-jaxpr --quiet \
     --emit-fault-inventory /tmp/fc_fault_inv.json
-if ! diff -u runs/faults_r18.json /tmp/fc_fault_inv.json; then
-    echo "runs/faults_r18.json is stale — regenerate with" \
+if ! diff -u runs/faults_r19.json /tmp/fc_fault_inv.json; then
+    echo "runs/faults_r19.json is stale — regenerate with" \
          "python -m fastconsensus_tpu.analysis fastconsensus_tpu/" \
-         "--no-jaxpr --emit-fault-inventory runs/faults_r18.json" >&2
+         "--no-jaxpr --emit-fault-inventory runs/faults_r19.json" >&2
     exit 1
 fi
 echo "fault inventory in sync with the raise-set analysis"
 
 echo "== fcfault: 3-site injection campaign (queue / device / drain path) =="
 # Every site's statically claimed absorbing boundary
-# (runs/faults_r18.json) is tested against a LIVE loopback pool: the
+# (runs/faults_r19.json) is tested against a LIVE loopback pool: the
 # injected job fails as itself, failure counters are stamped, sibling
 # jobs complete, and SIGTERM drain still exits 0.
 FAULT_DIR=$(mktemp -d)
@@ -1660,6 +1660,120 @@ if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "chaos drill lost"; then
     exit 1
 fi
 echo "serve_fleet gate ok: drill-regressed copy fails naming the drill rule"
+
+echo "== fcdelta: incremental-consensus smoke (warm delta, fallback, gate probe) =="
+DELTA_DIR=$(mktemp -d)
+# ROUNDS_BLOCK=2: fine-grained block quantization so the warm delta's
+# shorter re-consensus is visible in device time, not rounded up to
+# the parent's block count.  Both runs share the process, so the
+# executables (and the 0-warm-compile assertion) stay apples-to-apples.
+JAX_PLATFORMS=cpu FCTPU_ROUNDS_BLOCK=2 FCTPU_DETECT_CALL_MEMBERS=0 \
+python - <<'PYEOF'
+import threading
+import time
+
+from fastconsensus_tpu.obs import counters as obs_counters
+from fastconsensus_tpu.serve.client import ServeClient, ServeError
+from fastconsensus_tpu.serve.server import (ConsensusService,
+                                            ServeConfig,
+                                            make_http_server)
+from fastconsensus_tpu.serve.shaping import ShapingConfig
+from fastconsensus_tpu.utils.io import read_edgelist
+
+# pin_sizing=False so adaptive sizing cannot recompile mid-smoke: the
+# 0-warm-compile claim below must be about executable REUSE, not luck
+svc = ConsensusService(ServeConfig(queue_depth=8, pin_sizing=False,
+                                   shaping=ShapingConfig(shed=False)))
+svc.start()
+httpd = make_http_server(svc, "127.0.0.1", 0)
+threading.Thread(target=httpd.serve_forever, daemon=True).start()
+client = ServeClient(f"http://127.0.0.1:{httpd.server_address[1]}",
+                     timeout=60.0)
+edges, _, ids = read_edgelist("examples/karate_club.txt")
+n = len(ids)
+spec = dict(edges=edges.tolist(), n_nodes=n, algorithm="louvain",
+            n_p=4, tau=0.2, delta=0.02, max_rounds=32, seed=0)
+sub = client.submit(**spec)
+parent = client.wait(sub["job_id"], timeout=300)
+assert parent["converged"], parent
+parent_dev = parent["timing"]["phases_ms"]["device"]
+
+# 2%-edge delta on karate (2 of 78 edges): remove one real edge, add
+# one non-edge — resolves the cached parent, warm-starts the ensemble,
+# frontier-restricts the re-consensus
+reg = obs_counters.get_registry()
+base = reg.counters()
+ack = client.submit_delta(sub["content_hash"], adds=[[5, 30]],
+                          removes=[[0, 1]])
+assert ack["delta"]["mode"] == "incremental", ack["delta"]
+res = client.wait(ack["job_id"], timeout=300)
+assert res["delta"]["parent"] == sub["content_hash"], res["delta"]
+assert res["timing"]["slo"] == "delta", res["timing"]
+since = reg.counters_since(base)
+warm = since.get("serve.xla_compiles", 0)
+assert warm == 0, f"warm delta compiled {warm}x (bucketed reuse broke)"
+delta_dev = res["timing"]["phases_ms"]["device"]
+assert delta_dev < parent_dev, \
+    f"delta device {delta_dev}ms not below parent {parent_dev}ms"
+assert since.get("serve.delta.incremental", 0) == 1, since
+assert since.get("serve.cache.parent_pins", 0) >= 1, since
+assert not svc.cache.pinned(), svc.cache.pinned()  # resolve window closed
+
+# oversized delta (20 of 78 edges > 10% policy ceiling): honest
+# fallback to a full run, provenance says why
+adds = [[u, v] for u in range(n) for v in range(u + 1, n)
+        if not ((edges[:, 0] == u) & (edges[:, 1] == v)).any()
+        and not ((edges[:, 0] == v) & (edges[:, 1] == u)).any()
+        and (u, v) != (5, 30)][:20]
+big = client.submit_delta(sub["content_hash"], adds=adds)
+assert big["delta"]["mode"] == "fallback", big["delta"]
+assert big["delta"]["reason"] == "delta_too_large", big["delta"]
+client.wait(big["job_id"], timeout=300)
+
+# malformed delta: a line-numbered 400, not a queued failure
+try:
+    client.submit_delta(sub["content_hash"], adds=[[7, 7]])
+except ServeError as e:
+    assert e.status == 400 and "adds[0]" in e.payload["error"], e.payload
+else:
+    raise AssertionError("self-loop delta was accepted")
+
+httpd.shutdown()
+httpd.server_close()
+assert svc.drain(60)
+print(f"fcdelta smoke ok: warm delta {delta_dev:.0f}ms < parent "
+      f"{parent_dev:.0f}ms, 0 warm compiles, oversized delta fell "
+      f"back, malformed delta 400s")
+PYEOF
+rc=$?
+if [ $rc -ne 0 ]; then
+    echo "fcdelta smoke failed (exit $rc)" >&2
+    exit $rc
+fi
+# negative probe: a committed-artifact copy whose warm delta compiled,
+# sequenced one later, must FAIL check_delta naming the executable rule
+python - runs/bench_serve_delta_r19.json \
+    "$DELTA_DIR/bench_serve_delta_r99.json" <<'PYEOF'
+import json
+import sys
+
+doc = json.load(open(sys.argv[1]))
+sc = doc["telemetry"]["serve_delta"]["scenarios"]
+next(s for s in sc if s["mode"] == "incremental")["warm_compiles"] = 1
+json.dump(doc, open(sys.argv[2], "w"))
+PYEOF
+out=$(python scripts/bench_report.py --check --quiet \
+    runs/bench_serve_delta_r19.json \
+    "$DELTA_DIR/bench_serve_delta_r99.json" 2>&1)
+rc=$?
+if [ "$rc" -ne 1 ] || ! printf '%s' "$out" | grep -q "bucketed executables"; then
+    echo "compile-regressed serve_delta copy did not fail the gate" \
+         "(exit $rc):" >&2
+    echo "$out" >&2
+    exit 1
+fi
+rm -rf "$DELTA_DIR"
+echo "fcdelta gate ok: compile-regressed copy fails naming the executable rule"
 
 if [ "$1" = "--skip-tests" ]; then
     echo "fcheck clean (tests skipped)"

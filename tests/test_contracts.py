@@ -13,7 +13,7 @@ import pytest
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-INVENTORY = os.path.join(REPO, "runs", "contract_r18.json")
+INVENTORY = os.path.join(REPO, "runs", "contract_r19.json")
 
 
 def _lint(name):

@@ -18,7 +18,7 @@ import pytest
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-INVENTORY = os.path.join(REPO, "runs", "faults_r18.json")
+INVENTORY = os.path.join(REPO, "runs", "faults_r19.json")
 
 # a site hosted by the harness module itself: jax-free end to end, so
 # the poisoned-import subprocess below can arm and trip it

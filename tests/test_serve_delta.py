@@ -1,0 +1,544 @@
+"""fcdelta: incremental evolving-graph consensus (serve/delta.py).
+
+Covers the jax-free half (delta parsing/canonicalization set
+semantics, the warm-start-vs-fallback policy, the derived cache key,
+the lineage pin that holds a parent entry against LRU/TTL during the
+resolve window), the serving path (incremental delta runs warm-start
+and cache under the derived key; oversized and bucket-crossing deltas
+fall back; quality parity vs a from-scratch twin on karate), the HTTP
+wire (ack/status/result ``delta`` blocks, line-numbered 400s, 404 on
+an unresolvable parent), and the typed client (DeltaInfo parses with
+jax poisoned — thin front-ends never pay the engine import).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+
+def _ring_graph(n, chords=0, shift=7):
+    idx = np.arange(n)
+    edges = [np.stack([idx, (idx + 1) % n], 1)]
+    if chords:
+        c = np.arange(chords)
+        edges.append(np.stack([c % n, (c + shift) % n], 1))
+    return np.concatenate(edges).astype(np.int64)
+
+
+def _spec(edges, n_nodes, **over):
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.jobs import JobSpec
+
+    kwargs = dict(algorithm="louvain", n_p=4, tau=0.2, delta=0.02,
+                  max_rounds=2, seed=0)
+    kwargs.update(over)
+    return JobSpec(edges=np.asarray(edges, dtype=np.int64),
+                   n_nodes=n_nodes, config=ConsensusConfig(**kwargs))
+
+
+@pytest.fixture
+def service():
+    from fastconsensus_tpu.serve.server import (ConsensusService,
+                                                ServeConfig)
+    from fastconsensus_tpu.serve.shaping import ShapingConfig
+
+    return ConsensusService(ServeConfig(queue_depth=8, pin_sizing=False,
+                                        shaping=ShapingConfig(shed=False)))
+
+
+def _wait(job, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    # fcheck: ok=sync-in-loop (host-side completion poll in a test)
+    while job.state not in ("done", "failed"):
+        assert time.monotonic() < deadline, f"job stuck in {job.state}"
+        time.sleep(0.01)
+    assert job.state == "done", job.error
+    return job
+
+
+# -- delta canonicalization -------------------------------------------
+
+
+def test_parse_edge_pairs_order_and_orientation_invariant():
+    from fastconsensus_tpu.serve.delta import parse_edge_pairs
+
+    a = parse_edge_pairs([[3, 7], [1, 0], [9, 2]], "adds", 16)
+    b = parse_edge_pairs([[2, 9], [7, 3], [0, 1]], "adds", 16)
+    np.testing.assert_array_equal(a, b)
+    assert a.dtype == np.int64 and a.shape == (3, 2)
+    assert (a[:, 0] < a[:, 1]).all()
+    # sorted by canonical edge key
+    key = a[:, 0] * 16 + a[:, 1]
+    assert (np.diff(key) > 0).all()
+    # empty / None both canonicalize to [0, 2]
+    assert parse_edge_pairs(None, "adds", 16).shape == (0, 2)
+    assert parse_edge_pairs([], "adds", 16).shape == (0, 2)
+
+
+def test_parse_edge_pairs_rejections_name_the_index():
+    from fastconsensus_tpu.serve.delta import DeltaError, parse_edge_pairs
+
+    with pytest.raises(DeltaError, match=r"adds\[1\]: self-loop"):
+        parse_edge_pairs([[0, 1], [5, 5]], "adds", 16)
+    with pytest.raises(DeltaError,
+                       match=r"removes\[0\]: node 99 out of range"):
+        parse_edge_pairs([[0, 99]], "removes", 16)
+    with pytest.raises(DeltaError, match=r"adds\[2\]: duplicate edge"):
+        parse_edge_pairs([[0, 1], [2, 3], [1, 0]], "adds", 16)
+    with pytest.raises(DeltaError, match=r"adds\[0\]: expected a"):
+        parse_edge_pairs([[1, 2, 3]], "adds", 16)
+    with pytest.raises(DeltaError, match=r"adds\[0\]: endpoints"):
+        parse_edge_pairs([["x", 2]], "adds", 16)
+    with pytest.raises(DeltaError, match="must be a list"):
+        parse_edge_pairs("nope", "adds", 16)
+
+
+def test_parse_delta_rejects_empty_and_contradiction():
+    from fastconsensus_tpu.serve.delta import DeltaError, parse_delta
+
+    with pytest.raises(DeltaError, match="empty delta"):
+        parse_delta({}, 16)
+    with pytest.raises(DeltaError, match="both adds and removes"):
+        parse_delta({"adds": [[0, 1]], "removes": [[1, 0]]}, 16)
+    adds, removes = parse_delta({"adds": [[0, 1]],
+                                 "removes": [[2, 3]]}, 16)
+    assert adds.shape == (1, 2) and removes.shape == (1, 2)
+
+
+def test_apply_delta_set_semantics():
+    from fastconsensus_tpu.serve.delta import (DeltaError, apply_delta,
+                                               parse_edge_pairs)
+
+    # parent: path 0-1-2-3 (canonical sorted)
+    u = np.array([0, 1, 2], np.int64)
+    v = np.array([1, 2, 3], np.int64)
+    adds = parse_edge_pairs([[0, 3]], "adds", 4)
+    removes = parse_edge_pairs([[1, 2]], "removes", 4)
+    cu, cv, cw = apply_delta(u, v, None, 4, adds, removes)
+    assert cw is None
+    np.testing.assert_array_equal(cu, [0, 0, 2])
+    np.testing.assert_array_equal(cv, [1, 3, 3])
+    # canonical ascending order is preserved without a second sort
+    assert (np.diff(cu * 4 + cv) > 0).all()
+    # weighted parent: adds arrive at weight 1.0
+    w = np.array([2.0, 3.0, 4.0], np.float32)
+    _, _, cw2 = apply_delta(u, v, w, 4, adds, removes)
+    np.testing.assert_allclose(cw2, [2.0, 1.0, 4.0])
+    with pytest.raises(DeltaError, match=r"removes\[0\].*not present"):
+        apply_delta(u, v, None, 4,
+                    parse_edge_pairs([], "adds", 4),
+                    parse_edge_pairs([[0, 2]], "removes", 4))
+    with pytest.raises(DeltaError, match=r"adds\[0\].*already present"):
+        apply_delta(u, v, None, 4,
+                    parse_edge_pairs([[1, 2]], "adds", 4),
+                    parse_edge_pairs([], "removes", 4))
+    with pytest.raises(DeltaError, match="empty the graph"):
+        apply_delta(np.array([0], np.int64), np.array([1], np.int64),
+                    None, 4, parse_edge_pairs([], "adds", 4),
+                    parse_edge_pairs([[0, 1]], "removes", 4))
+
+
+def test_neighborhood_mask_is_one_hop_in_child():
+    from fastconsensus_tpu.serve.delta import (neighborhood_mask,
+                                               parse_edge_pairs)
+
+    # child graph: ring of 8.  Change touches edge (0, 1).
+    e = _ring_graph(8)
+    u = np.minimum(e[:, 0], e[:, 1]).astype(np.int64)
+    v = np.maximum(e[:, 0], e[:, 1]).astype(np.int64)
+    adds = parse_edge_pairs([[0, 1]], "adds", 8)
+    mask = neighborhood_mask(u, v, 8, adds,
+                             parse_edge_pairs([], "removes", 8))
+    # endpoints 0,1 plus their ring neighbors 7 and 2 — nothing else
+    assert mask.dtype == np.bool_ and mask.shape == (8,)
+    assert set(np.flatnonzero(mask).tolist()) == {0, 1, 2, 7}
+
+
+def test_delta_cache_key_never_shadows_content_hash():
+    from fastconsensus_tpu.serve.delta import delta_cache_key
+
+    key = delta_cache_key("c" * 32, "p" * 32)
+    assert key.startswith("c" * 32 + ":delta:")
+    assert key != "c" * 32
+    # parent prefix is bounded, so keys stay short and scannable
+    assert key.endswith("p" * 16)
+
+
+# -- policy ------------------------------------------------------------
+
+
+def _good_parent(n_p=4):
+    return {
+        "partitions": [[0, 0, 1]] * n_p,
+        "converged": True,
+        "quality": {"final_agreement": 0.9, "final_churn_frac": 0.1},
+    }
+
+
+def test_policy_reasons_in_precedence_order():
+    from fastconsensus_tpu.consensus import ConsensusConfig
+    from fastconsensus_tpu.serve.delta import DeltaPolicy
+
+    pol = DeltaPolicy()
+    cfg = ConsensusConfig(n_p=4)
+    ok = dict(n_changed=1, n_parent_edges=100, parent=_good_parent(),
+              config=cfg, parent_bucket_key="n64_e96",
+              child_bucket_key="n64_e96", warm_capable=True)
+    d = pol.decide(**ok)
+    assert d.mode == "incremental" and d.reason is None
+    assert d.delta_frac == 0.01
+
+    assert pol.decide(**dict(ok, warm_capable=False)).reason == \
+        "detector_no_warm"
+    assert pol.decide(**dict(ok, huge=True)).reason == "huge_tier"
+    assert pol.decide(**dict(ok, n_changed=11)).reason == \
+        "delta_too_large"
+    assert pol.decide(**dict(ok, child_bucket_key="n64_e128")).reason \
+        == "bucket_boundary"
+    assert pol.decide(**dict(
+        ok, parent=dict(_good_parent(), partitions=[[0]]))).reason == \
+        "ensemble_mismatch"
+    assert pol.decide(**dict(
+        ok, parent=dict(_good_parent(), converged=False))).reason == \
+        "parent_unconverged"
+    assert pol.decide(**dict(
+        ok, parent=dict(_good_parent(), quality=None))).reason == \
+        "parent_quality_missing"
+    low = dict(_good_parent(),
+               quality={"final_agreement": 0.2, "final_churn_frac": 0.1})
+    assert pol.decide(**dict(ok, parent=low)).reason == \
+        "low_parent_agreement"
+    churny = dict(_good_parent(),
+                  quality={"final_agreement": 0.9,
+                           "final_churn_frac": 0.9})
+    assert pol.decide(**dict(ok, parent=churny)).reason == \
+        "high_parent_churn"
+
+
+# -- cache lineage pins ------------------------------------------------
+
+
+def test_pin_holds_parent_against_lru_eviction_under_contention():
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.cache import ResultCache
+
+    reg = obs_counters.get_registry()
+    base = reg.counters()
+    cache = ResultCache(max_entries=2)
+    cache.put("parent", {"v": 1})
+    assert cache.pin("parent") is True
+    assert cache.pinned() == {"parent": 1}
+    # contention: pour entries through a 2-slot cache; the pinned
+    # parent is the LRU victim every time and must survive anyway
+    for i in range(6):
+        cache.put(f"k{i}", {"v": i})
+    assert cache.get("parent", count_miss=False) == {"v": 1}
+    assert len(cache) <= 2 + 1  # transient overshoot bounded by pins
+    since = reg.counters_since(base)
+    assert since.get("serve.cache.parent_pins", 0) == 1
+    # release: the parent becomes ordinary LRU fodder again
+    cache.unpin("parent")
+    assert cache.pinned() == {}
+    for i in range(6, 9):
+        cache.put(f"k{i}", {"v": i})
+    assert cache.get("parent", count_miss=False) is None
+    assert len(cache) == 2
+
+
+def test_pin_holds_parent_against_ttl_and_refcounts():
+    from fastconsensus_tpu.serve.cache import ResultCache
+
+    now = [0.0]
+    cache = ResultCache(max_entries=4, ttl_seconds=10.0,
+                        clock=lambda: now[0])
+    cache.put("parent", {"v": 1})
+    assert cache.pin("parent") and cache.pin("parent")
+    assert cache.pinned() == {"parent": 2}
+    now[0] = 100.0                      # far past the TTL
+    assert cache.get("parent", count_miss=False) == {"v": 1}
+    cache.unpin("parent")
+    assert cache.pinned() == {"parent": 1}  # refcounted: one pin left
+    assert cache.get("parent", count_miss=False) == {"v": 1}
+    cache.unpin("parent")
+    # last unpin: the overdue entry drops on the next touch
+    assert cache.get("parent", count_miss=False) is None
+
+
+def test_pin_refuses_absent_and_expired_entries():
+    from fastconsensus_tpu.serve.cache import ResultCache
+
+    now = [0.0]
+    cache = ResultCache(max_entries=4, ttl_seconds=10.0,
+                        clock=lambda: now[0])
+    assert cache.pin("ghost") is False
+    cache.put("old", {"v": 1})
+    now[0] = 100.0
+    assert cache.pin("old") is False    # expired: not pinnable
+    assert cache.pinned() == {}
+    cache.unpin("ghost")                # unknown unpin is a no-op
+
+
+# -- serving path ------------------------------------------------------
+
+
+def _nonedge(edges, n_nodes, want=1, forbid=()):
+    """Deterministic [u, v] pairs absent from ``edges``."""
+    eset = {(min(a, b), max(a, b)) for a, b in np.asarray(edges).tolist()}
+    eset.update((min(a, b), max(a, b)) for a, b in forbid)
+    out = []
+    for a in range(n_nodes):
+        for b in range(a + 1, n_nodes):
+            if (a, b) not in eset:
+                out.append([a, b])
+                eset.add((a, b))
+                if len(out) == want:
+                    return out
+    raise AssertionError("graph is complete")
+
+
+def test_incremental_delta_warm_starts_and_caches_under_derived_key(
+        service, karate_edges):
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.delta import delta_cache_key
+
+    edges, _, ids = karate_edges
+    n = len(ids)
+    service.start()
+    try:
+        parent = _wait(service.submit(_spec(edges, n, max_rounds=32)))
+        assert parent.result["converged"]
+        # the cached parent carries its lineage blocks
+        assert sorted(parent.result["graph"]) == ["u", "v", "w"]
+        assert parent.result["config"]["n_p"] == 4
+
+        reg = obs_counters.get_registry()
+        base = reg.counters()
+        add = _nonedge(edges, n)[0]
+        job = service.submit_delta({"parent": parent.key,
+                                    "adds": [add],
+                                    "removes": [[0, 1]]})
+        _wait(job)
+        info = job.spec.delta
+        assert info["mode"] == "incremental" and info["reason"] is None
+        assert info["parent"] == parent.key
+        assert info["n_adds"] == 1 and info["n_removes"] == 1
+        assert 0 < info["delta_frac"] < 0.10
+        # delta submissions get their own SLO class and never coalesce
+        assert job.spec.slo_class() == "delta"
+        assert "delta-solo" in job.spec.batch_group()
+        since = reg.counters_since(base)
+        assert since.get("serve.delta.incremental", 0) == 1
+        assert since.get("serve.cache.parent_pins", 0) == 1
+        # resolve window closed: no pin leaks
+        assert service.cache.pinned() == {}
+        # cached under the DERIVED key — the approximate answer must
+        # never shadow the child graph's exact content hash
+        assert job.key == delta_cache_key(
+            job.key.split(":delta:")[0], parent.key)
+        assert ":delta:" in job.key
+        child_hash = job.key.split(":delta:")[0]
+        assert service.cache.get(job.key, count_miss=False) is not None
+        assert service.cache.get(child_hash, count_miss=False) is None
+        # an identical delta resubmit dedups exactly
+        again = service.submit_delta({"parent": parent.key,
+                                      "adds": [add],
+                                      "removes": [[0, 1]]})
+        assert again.state == "done" and again.result["cached"]
+    finally:
+        assert service.drain(60)
+
+
+def test_incremental_quality_parity_with_scratch_on_karate(
+        service, karate_edges, karate_truth):
+    from fastconsensus_tpu.utils.metrics import nmi
+
+    edges, _, ids = karate_edges
+    n = len(ids)
+    service.start()
+    try:
+        parent = _wait(service.submit(_spec(edges, n, max_rounds=32)))
+        add = _nonedge(edges, n)[0]
+        inc = _wait(service.submit_delta({"parent": parent.key,
+                                          "adds": [add],
+                                          "removes": [[0, 1]]}))
+        assert inc.spec.delta["mode"] == "incremental"
+        # the from-scratch twin of the SAME child graph + config: runs
+        # fresh because the incremental result lives under the derived
+        # key, never under the child's content hash
+        child = np.concatenate([edges[~((edges[:, 0] == 0) &
+                                        (edges[:, 1] == 1)) &
+                                      ~((edges[:, 0] == 1) &
+                                        (edges[:, 1] == 0))],
+                                np.asarray([add], np.int64)])
+        scratch = _wait(service.submit(_spec(child, n, max_rounds=32)))
+        assert not scratch.result["cached"]
+        truth = np.asarray(karate_truth)
+        inc_nmi = float(nmi(np.asarray(inc.result["partitions"][0]),
+                            truth))
+        scr_nmi = float(nmi(np.asarray(scratch.result["partitions"][0]),
+                            truth))
+        # the ISSUE acceptance band: warm-start + frontier restriction
+        # must not cost more than 0.02 NMI vs recomputing
+        assert inc_nmi >= scr_nmi - 0.02, (inc_nmi, scr_nmi)
+    finally:
+        assert service.drain(60)
+
+
+def test_bucket_boundary_delta_falls_back(service):
+    from fastconsensus_tpu.serve import bucketer
+
+    # sit the parent EXACTLY on an edge-class boundary so one net-add
+    # crosses into the next bucket (different executables + padding)
+    n = 64
+    edges = _ring_graph(n, chords=32)           # 96 edges
+    b_parent = bucketer.bucket_for(n, 96)
+    b_child = bucketer.bucket_for(n, 97)
+    assert b_parent.key() != b_child.key()
+    service.start()
+    try:
+        parent = _wait(service.submit(_spec(edges, n, max_rounds=32)))
+        adds = _nonedge(edges, n)[:1]
+        job = _wait(service.submit_delta({"parent": parent.key,
+                                          "adds": adds}))
+        assert job.spec.delta["mode"] == "fallback"
+        assert job.spec.delta["reason"] == "bucket_boundary"
+        # fallback is a full run: cached under the PLAIN content hash
+        assert ":delta:" not in job.key
+    finally:
+        assert service.drain(60)
+
+
+def test_oversized_delta_falls_back(service, karate_edges):
+    edges, _, ids = karate_edges
+    n = len(ids)
+    service.start()
+    try:
+        parent = _wait(service.submit(_spec(edges, n, max_rounds=32)))
+        adds = _nonedge(edges, n, want=20)      # 20/78 > 10%
+        job = _wait(service.submit_delta({"parent": parent.key,
+                                          "adds": adds}))
+        assert job.spec.delta["mode"] == "fallback"
+        assert job.spec.delta["reason"] == "delta_too_large"
+    finally:
+        assert service.drain(60)
+
+
+def test_unknown_parent_raises_and_counts(service):
+    from fastconsensus_tpu.obs import counters as obs_counters
+    from fastconsensus_tpu.serve.delta import ParentNotCached
+
+    reg = obs_counters.get_registry()
+    base = reg.counters()
+    service.start()
+    try:
+        with pytest.raises(ParentNotCached):
+            service.submit_delta({"parent": "feedfeedfeedfeed",
+                                  "adds": [[0, 1]]})
+        assert reg.counters_since(base).get(
+            "serve.delta.parent_miss", 0) == 1
+    finally:
+        assert service.drain(60)
+
+
+# -- HTTP wire + typed client ------------------------------------------
+
+
+def test_delta_http_roundtrip(service, karate_edges):
+    import threading
+
+    from fastconsensus_tpu.serve.client import (DeltaInfo, ServeClient,
+                                                ServeError)
+    from fastconsensus_tpu.serve.server import make_http_server
+
+    edges, _, ids = karate_edges
+    n = len(ids)
+    service.start()
+    httpd = make_http_server(service, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    client = ServeClient(f"http://127.0.0.1:{port}", timeout=30.0)
+    try:
+        sub = client.submit(edges=edges.tolist(), n_nodes=n,
+                            algorithm="louvain", n_p=4, tau=0.2,
+                            delta=0.02, max_rounds=32, seed=0)
+        client.wait(sub["job_id"], timeout=120)
+        add = _nonedge(edges, n)[0]
+        ack = client.submit_delta(sub["content_hash"], adds=[add],
+                                  removes=[[0, 1]])
+        # the ack itself carries the provenance block
+        assert ack["delta"]["mode"] == "incremental"
+        res = client.wait(ack["job_id"], timeout=120)
+        # /result: delta block present, lineage graph block STRIPPED
+        assert res["delta"]["parent"] == sub["content_hash"]
+        assert "graph" not in res
+        assert res["timing"]["slo"] == "delta"
+        # typed accessor over /status
+        info = client.delta_info(ack["job_id"])
+        assert isinstance(info, DeltaInfo) and info.incremental
+        assert info.parent == sub["content_hash"]
+        assert info.n_adds == 1 and info.n_removes == 1
+        # plain jobs carry no delta block
+        assert client.delta_info(sub["job_id"]) is None
+
+        # 404: unresolvable parent names the hash
+        with pytest.raises(ServeError) as e404:
+            client.submit_delta("feedfeedfeedfeed", adds=[[0, 1]])
+        assert e404.value.status == 404
+        assert e404.value.payload["parent"] == "feedfeedfeedfeed"
+        # 400: malformed delta names the offending index
+        with pytest.raises(ServeError) as e400:
+            client.submit_delta(sub["content_hash"], adds=[[5, 5]])
+        assert e400.value.status == 400
+        assert "adds[0]" in e400.value.payload["error"]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        assert service.drain(60)
+
+
+def test_delta_info_parses_in_jax_free_client():
+    """The typed client must parse the delta block with jax poisoned —
+    delta submitters are thin front-ends (cli.py --server posture)."""
+    canned = {"parent": "ab" * 16, "mode": "incremental",
+              "reason": None, "delta_frac": 0.0123,
+              "n_adds": 3, "n_removes": 1}
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "import json\n"
+        "from fastconsensus_tpu.serve.client import (DeltaInfo,\n"
+        "                                            ServeClient)\n"
+        f"d = json.loads({json.dumps(json.dumps(canned))})\n"
+        "di = DeltaInfo.from_payload(d)\n"
+        "assert di.incremental and di.reason is None\n"
+        "assert di.parent == 'ab' * 16 and di.n_adds == 3\n"
+        "assert di.delta_frac == 0.0123\n"
+        "c = ServeClient('http://example.invalid')\n"
+        "assert callable(c.submit_delta)\n"
+        "print('jax-free delta parse ok')\n")
+    root = os.path.join(os.path.dirname(__file__), "..")
+    env = dict(os.environ, PYTHONPATH=os.path.abspath(root))
+    res = subprocess.run([sys.executable, "-c", code], cwd=root, env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "jax-free delta parse ok" in res.stdout
+
+
+# -- router routing key ------------------------------------------------
+
+
+def test_router_route_key_uses_parent_hash_for_deltas():
+    from fastconsensus_tpu.serve.router import route_key
+
+    k1 = route_key({"parent": "ab" * 16, "adds": [[0, 1]]})
+    k2 = route_key({"parent": "ab" * 16, "removes": [[2, 3]]})
+    k3 = route_key({"parent": "cd" * 16, "adds": [[0, 1]]})
+    # every delta evolving one graph routes together; different
+    # lineages may land elsewhere
+    assert k1 == k2 and k1 != k3
+    assert k1.startswith("delta|")

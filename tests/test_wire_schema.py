@@ -5,7 +5,7 @@ field-for-field against the typed client parsers in a subprocess where
 any jax import raises — pinning that (a) every field the server emits
 is consumed by the matching parser (no silently-dropped keys), (b) the
 parsers run jax-free, and (c) the live metric names union cleanly with
-the committed static writer inventory (``runs/contract_r18.json``)."""
+the committed static writer inventory (``runs/contract_r19.json``)."""
 
 import json
 import os
@@ -125,7 +125,7 @@ _VALIDATOR = textwrap.dedent("""\
 
     # runtime half of the contract: live names vs the committed
     # static writer inventory
-    inv_path = repo + "/runs/contract_r18.json"
+    inv_path = repo + "/runs/contract_r19.json"
     n = contracts.assert_covered(m, inv_path)
     assert n >= 10, f"suspiciously few live metrics ({n})"
 
